@@ -1,0 +1,239 @@
+"""Pure-Python BLS12-381 group law: G1 (over Fp) and G2 (over Fp2).
+
+Oracle counterpart of the point arithmetic inside the reference's blst
+backend (crypto/bls/src/impls/blst.rs). Includes:
+  - affine/Jacobian arithmetic generic over Fp and Fp2,
+  - ZCash-format compressed serialization (48-byte G1 / 96-byte G2),
+  - the psi (untwist-Frobenius-twist) endomorphism on G2,
+  - fast subgroup checks and cofactor clearing for G2,
+  - constant-free derivation of endomorphism coefficients from (P, XI).
+"""
+
+from __future__ import annotations
+
+from .constants import B1, B2, BLS_X, G1_X, G1_Y, G2_X, G2_Y, H1, P, R
+from .fields_ref import Fp, Fp2
+
+_HALF_P = (P - 1) // 2
+
+
+class Point:
+    """Affine point with projective infinity sentinel, generic over the field."""
+
+    __slots__ = ("x", "y", "inf")
+
+    def __init__(self, x, y, inf: bool = False):
+        self.x, self.y, self.inf = x, y, inf
+
+    # -- group law (affine; oracle clarity over speed) ---------------------
+    def __neg__(self):
+        return self if self.inf else Point(self.x, -self.y, False)
+
+    def __eq__(self, o):
+        if not isinstance(o, Point):
+            return NotImplemented
+        if self.inf or o.inf:
+            return self.inf == o.inf
+        return self.x == o.x and self.y == o.y
+
+    def __hash__(self):
+        return hash(("Point", None if self.inf else (self.x, self.y)))
+
+    def double(self):
+        if self.inf or self.y.is_zero():
+            return Point(self.x, self.y, True)
+        three = self.x + self.x + self.x
+        lam = (three * self.x) * (self.y + self.y).inv()
+        x3 = lam * lam - self.x - self.x
+        y3 = lam * (self.x - x3) - self.y
+        return Point(x3, y3, False)
+
+    def __add__(self, o):
+        if self.inf:
+            return o
+        if o.inf:
+            return self
+        if self.x == o.x:
+            if self.y == o.y:
+                return self.double()
+            return Point(self.x, self.y, True)
+        lam = (o.y - self.y) * (o.x - self.x).inv()
+        x3 = lam * lam - self.x - o.x
+        y3 = lam * (self.x - x3) - self.y
+        return Point(x3, y3, False)
+
+    def mul(self, k: int):
+        if k < 0:
+            return (-self).mul(-k)
+        out = Point(self.x, self.y, True)
+        add = self
+        while k:
+            if k & 1:
+                out = out + add
+            add = add.double()
+            k >>= 1
+        return out
+
+    def __repr__(self):
+        return "Point(inf)" if self.inf else f"Point({self.x}, {self.y})"
+
+
+def g1_generator() -> Point:
+    return Point(Fp(G1_X), Fp(G1_Y))
+
+
+def g2_generator() -> Point:
+    return Point(Fp2(*G2_X), Fp2(*G2_Y))
+
+
+def is_on_g1(p: Point) -> bool:
+    if p.inf:
+        return True
+    return p.y * p.y == p.x * p.x * p.x + Fp(B1)
+
+
+def is_on_g2(p: Point) -> bool:
+    if p.inf:
+        return True
+    return p.y * p.y == p.x * p.x * p.x + Fp2(*B2)
+
+
+# --- psi endomorphism on G2 ------------------------------------------------
+# psi = untwist o Frobenius o twist. With the twist used here (M-twist with
+# xi = 1 + u), psi(x, y) = (c_x * conj(x), c_y * conj(y)) where
+# c_x = 1 / xi^((p-1)/3) and c_y = 1 / xi^((p-1)/2), derived at import time.
+from .fields_ref import XI  # noqa: E402
+
+_PSI_CX = XI.pow((P - 1) // 3).inv()
+_PSI_CY = XI.pow((P - 1) // 2).inv()
+
+
+def psi(p: Point) -> Point:
+    if p.inf:
+        return p
+    return Point(p.x.conj() * _PSI_CX, p.y.conj() * _PSI_CY, False)
+
+
+def g1_subgroup_check(p: Point) -> bool:
+    """Slow-but-sure [r]P == O. (Fast sigma-endomorphism check is a TPU-side
+    optimization; the oracle favors the definitional test.)"""
+    return p.mul(R).inf
+
+
+def g2_subgroup_check(p: Point) -> bool:
+    return p.mul(R).inf
+
+
+def g2_subgroup_check_psi(p: Point) -> bool:
+    """Fast check: P in G2  iff  psi(P) == [x]P (x = BLS parameter).
+
+    Equivalent to the check blst performs; validated against the [r]P == O
+    definition in tests/test_bls_ref.py.
+    """
+    if p.inf:
+        return True
+    return psi(p) == p.mul(BLS_X)
+
+
+def clear_cofactor_g1(p: Point) -> Point:
+    return p.mul(H1)
+
+
+def clear_cofactor_g2(p: Point) -> Point:
+    """Efficient cofactor clearing (Budroni-Pintore):
+        [x^2 - x - 1]P + [x - 1]psi(P) + psi(psi([2]P)).
+    Used by RFC 9380 for BLS12-381 G2; tested to land in the r-torsion.
+    """
+    x = BLS_X
+    t0 = p.mul(x * x - x - 1)
+    t1 = psi(p).mul(x - 1)
+    t2 = psi(psi(p.double()))
+    return t0 + t1 + t2
+
+
+# --- ZCash-format compressed serialization --------------------------------
+
+
+def _y_is_lexically_largest_fp(y: Fp) -> bool:
+    return y.n > _HALF_P
+
+
+def _y_is_lexically_largest_fp2(y: Fp2) -> bool:
+    if y.c1.n != 0:
+        return y.c1.n > _HALF_P
+    return y.c0.n > _HALF_P
+
+
+def g1_to_bytes(p: Point) -> bytes:
+    if p.inf:
+        return bytes([0xC0]) + bytes(47)
+    out = bytearray(p.x.n.to_bytes(48, "big"))
+    out[0] |= 0x80
+    if _y_is_lexically_largest_fp(p.y):
+        out[0] |= 0x20
+    return bytes(out)
+
+
+def g2_to_bytes(p: Point) -> bytes:
+    if p.inf:
+        return bytes([0xC0]) + bytes(95)
+    out = bytearray(p.x.c1.n.to_bytes(48, "big") + p.x.c0.n.to_bytes(48, "big"))
+    out[0] |= 0x80
+    if _y_is_lexically_largest_fp2(p.y):
+        out[0] |= 0x20
+    return bytes(out)
+
+
+class DeserializeError(ValueError):
+    pass
+
+
+def _flags(b: bytes):
+    return bool(b[0] & 0x80), bool(b[0] & 0x40), bool(b[0] & 0x20)
+
+
+def g1_from_bytes(b: bytes) -> Point:
+    if len(b) != 48:
+        raise DeserializeError("G1 compressed must be 48 bytes")
+    comp, inf, sign = _flags(b)
+    if not comp:
+        raise DeserializeError("uncompressed flag unsupported on 48-byte input")
+    if inf:
+        if any(b[1:]) or (b[0] & 0x3F):
+            raise DeserializeError("bad infinity encoding")
+        return Point(Fp.zero(), Fp.zero(), True)
+    x = int.from_bytes(b, "big") & ((1 << 381) - 1)
+    if x >= P:
+        raise DeserializeError("x out of range")
+    xf = Fp(x)
+    y2 = xf * xf * xf + Fp(B1)
+    y = y2.sqrt()
+    if y is None:
+        raise DeserializeError("x not on curve")
+    if _y_is_lexically_largest_fp(y) != sign:
+        y = -y
+    return Point(xf, y, False)
+
+
+def g2_from_bytes(b: bytes) -> Point:
+    if len(b) != 96:
+        raise DeserializeError("G2 compressed must be 96 bytes")
+    comp, inf, sign = _flags(b)
+    if not comp:
+        raise DeserializeError("uncompressed flag unsupported on 96-byte input")
+    if inf:
+        if any(b[1:]) or (b[0] & 0x3F):
+            raise DeserializeError("bad infinity encoding")
+        return Point(Fp2.zero(), Fp2.zero(), True)
+    x_c1 = int.from_bytes(b[:48], "big") & ((1 << 381) - 1)
+    x_c0 = int.from_bytes(b[48:], "big")
+    if x_c1 >= P or x_c0 >= P:
+        raise DeserializeError("x out of range")
+    xf = Fp2(x_c0, x_c1)
+    y2 = xf * xf * xf + Fp2(*B2)
+    y = y2.sqrt()
+    if y is None:
+        raise DeserializeError("x not on curve")
+    if _y_is_lexically_largest_fp2(y) != sign:
+        y = -y
+    return Point(xf, y, False)

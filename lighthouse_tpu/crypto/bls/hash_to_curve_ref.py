@@ -1,0 +1,122 @@
+"""RFC 9380 hash-to-curve for BLS12-381 G2 (BLS12381G2_XMD:SHA-256_SSWU_RO_).
+
+Pure-Python oracle. The split mirrors the TPU design: `expand_message_xmd`
+and `hash_to_field` are cheap SHA-256 host work; `map_to_curve` (SSWU +
+3-isogeny + cofactor clearing) is heavy field arithmetic that the TPU
+backend executes on device for batches of messages.
+
+The 3-isogeny coefficients live in constants.py (ISO3_*); their correctness
+is enforced structurally by tests: the image of the map must lie on E2 and
+clear_cofactor must land in the r-torsion.
+"""
+
+from __future__ import annotations
+
+import hashlib
+
+from .constants import (
+    DST,
+    ISO3_X_DEN,
+    ISO3_X_NUM,
+    ISO3_Y_DEN,
+    ISO3_Y_NUM,
+    P,
+    SSWU_A2,
+    SSWU_B2,
+    SSWU_Z2,
+)
+from .curve_ref import Point, clear_cofactor_g2
+from .fields_ref import Fp, Fp2
+
+_L = 64  # bytes per field-element draw: ceil((381 + 128) / 8)
+
+
+def expand_message_xmd(msg: bytes, dst: bytes, len_in_bytes: int) -> bytes:
+    """RFC 9380 section 5.3.1, H = SHA-256."""
+    b_in_bytes = 32
+    r_in_bytes = 64
+    ell = (len_in_bytes + b_in_bytes - 1) // b_in_bytes
+    if ell > 255 or len(dst) > 255:
+        raise ValueError("expand_message_xmd bounds exceeded")
+    dst_prime = dst + len(dst).to_bytes(1, "big")
+    z_pad = bytes(r_in_bytes)
+    l_i_b_str = len_in_bytes.to_bytes(2, "big")
+    b0 = hashlib.sha256(z_pad + msg + l_i_b_str + b"\x00" + dst_prime).digest()
+    b = [hashlib.sha256(b0 + b"\x01" + dst_prime).digest()]
+    for i in range(2, ell + 1):
+        prev = bytes(x ^ y for x, y in zip(b0, b[-1]))
+        b.append(hashlib.sha256(prev + i.to_bytes(1, "big") + dst_prime).digest())
+    return b"".join(b)[:len_in_bytes]
+
+
+def hash_to_field_fp2(msg: bytes, count: int, dst: bytes = DST) -> list[Fp2]:
+    """RFC 9380 section 5.2 with m = 2, L = 64."""
+    len_in_bytes = count * 2 * _L
+    uniform = expand_message_xmd(msg, dst, len_in_bytes)
+    out = []
+    for i in range(count):
+        coords = []
+        for j in range(2):
+            off = _L * (j + i * 2)
+            coords.append(int.from_bytes(uniform[off : off + _L], "big") % P)
+        out.append(Fp2(coords[0], coords[1]))
+    return out
+
+
+_A = Fp2(*SSWU_A2)
+_B = Fp2(*SSWU_B2)
+_Z = Fp2(*SSWU_Z2)
+
+
+def map_to_curve_sswu_prime(u: Fp2) -> tuple[Fp2, Fp2]:
+    """Simplified SWU on the isogenous curve E2': y^2 = x^3 + A'x + B'
+    (RFC 9380 section 6.6.2)."""
+    u2 = u.sq()
+    zu2 = _Z * u2
+    tv1 = zu2.sq() + zu2  # Z^2 u^4 + Z u^2
+    if tv1.is_zero():
+        x1 = _B * (_Z * _A).inv()
+    else:
+        x1 = (-_B) * _A.inv() * (tv1.inv() + Fp2.one())
+    gx1 = (x1.sq() + _A) * x1 + _B
+    x2 = zu2 * x1
+    gx2 = (x2.sq() + _A) * x2 + _B
+    y1 = gx1.sqrt()
+    if y1 is not None:
+        x, y = x1, y1
+    else:
+        x, y = x2, gx2.sqrt()
+        assert y is not None, "SSWU: gx2 must be square when gx1 is not"
+    if u.sgn0() != y.sgn0():
+        y = -y
+    return x, y
+
+
+def _horner(coeffs, x: Fp2) -> Fp2:
+    acc = Fp2(*coeffs[-1])
+    for c in reversed(coeffs[:-1]):
+        acc = acc * x + Fp2(*c)
+    return acc
+
+
+def iso3_map(x: Fp2, y: Fp2) -> Point:
+    """3-isogeny E2' -> E2 (RFC 9380 Appendix E.3)."""
+    x_num = _horner(ISO3_X_NUM, x)
+    x_den = _horner(ISO3_X_DEN, x)
+    y_num = _horner(ISO3_Y_NUM, x)
+    y_den = _horner(ISO3_Y_DEN, x)
+    if x_den.is_zero() or y_den.is_zero():
+        # isogeny pole: maps to the point at infinity (RFC 9380 section 6.6.3)
+        return Point(Fp2.zero(), Fp2.zero(), True)
+    return Point(x_num * x_den.inv(), y * y_num * y_den.inv(), False)
+
+
+def map_to_curve_g2(u: Fp2) -> Point:
+    return iso3_map(*map_to_curve_sswu_prime(u))
+
+
+def hash_to_g2(msg: bytes, dst: bytes = DST) -> Point:
+    """hash_to_curve: two field draws, two maps, add on E2, clear cofactor."""
+    u0, u1 = hash_to_field_fp2(msg, 2, dst)
+    q = map_to_curve_g2(u0) + map_to_curve_g2(u1)
+    return clear_cofactor_g2(q)

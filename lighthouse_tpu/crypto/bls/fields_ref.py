@@ -330,7 +330,9 @@ class Fp12:
 
 # Frobenius coefficients: gamma_{1,j} = xi^(j (p-1)/6) for j = 1..5, computed
 # at import time from the primary parameters (no hard-coded magic numbers).
-_FROB_GAMMA = [XI.pow(j * (P - 1) // 6) for j in range(6)]
+# Single source of truth -- the TPU tower imports these (FROB_GAMMA).
+FROB_GAMMA = [XI.pow(j * (P - 1) // 6) for j in range(6)]
+_FROB_GAMMA = FROB_GAMMA
 
 
 def _frobenius_once(x: Fp12) -> Fp12:

@@ -1,0 +1,299 @@
+"""TPU-native Fp arithmetic for BLS12-381: 13-bit signed int32 limbs.
+
+This layer replaces blst's C/assembly big-int core (the FFI boundary at
+reference crypto/bls/src/impls/blst.rs). The design is driven by TPU/XLA
+constraints, not CPU big-int idioms:
+
+  * No 64-bit multiply on the VPU -> limbs are 13 bits in int32 lanes, so a
+    schoolbook column sum (31 products of <= 2^26 each = 2^30.95) never
+    overflows a signed 32-bit accumulator.
+  * Carries are LAZY and fully data-parallel: three shift/add rounds bring
+    any int32 column vector to limbs in [-1, 2^13]; no sequential scan in the
+    hot path.
+  * Modular reduction is a constant-matrix fold: limbs above position 30 are
+    contracted with FOLD_R[j] = limbs(2^(13*(30+j)) mod p), a compile-time
+    constant, chunked so partial sums stay under 2^31.
+  * Working values use W = 31 limbs -- one guard limb of headroom -- in a
+    redundant "lazy" form: limbs in [-1, 2^13], |value| < 2^392, congruent
+    mod p. The guard limb is what makes hot-path truncation safe: a value
+    bounded by 2^393 can never populate limb 31 (weight 2^403) after carry.
+  * Exact canonicalization (canon) happens only at boundaries (equality,
+    serialization) via lax.scan carries + a float32 Barrett quotient step.
+
+All functions are shape-polymorphic over leading batch axes (limbs on the
+LAST axis); batching never needs vmap. Differentially tested against the
+pure-Python oracle in tests/test_tpu_limbs.py, including adversarial
+all-limbs-maximal inputs that pin the overflow analysis.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+from ..constants import P
+
+BITS = 13
+NLIMBS = 30  # canonical width: 390 bits >= 381
+W = NLIMBS + 1  # working width (one guard limb)
+BASE = 1 << BITS
+MASK = BASE - 1
+_FOLD_CHUNK = 16  # rows per fold contraction: 16 * 2^26 + slack < 2^31
+
+
+def to_limbs(x: int, width: int = W) -> np.ndarray:
+    """Host: python int in [0, 2^(13*width)) -> int32[width]."""
+    assert 0 <= x < (1 << (BITS * width))
+    out = np.empty(width, np.int32)
+    for i in range(width):
+        out[i] = x & MASK
+        x >>= BITS
+    return out
+
+
+def to_int(a) -> int:
+    """Host: limb vector (lazy/signed ok) -> exact python int value."""
+    a = np.asarray(a)
+    val = 0
+    for i in reversed(range(a.shape[-1])):
+        val = (val << BITS) + int(a[i])
+    return val
+
+
+# Fold matrix: FOLD_R[j] = limbs(2^(BITS*(NLIMBS+j)) mod P), entries in [0, 2^13).
+# Width W rows cover the widest fold input (a 61-column product + carry slack).
+_N_FOLD_ROWS = 2 * W + 6 - NLIMBS
+FOLD_R = jnp.asarray(
+    np.stack(
+        [to_limbs(pow(2, BITS * (NLIMBS + j), P)) for j in range(_N_FOLD_ROWS)]
+    ),
+    jnp.int32,
+)
+
+P_LIMBS = jnp.asarray(to_limbs(P), jnp.int32)  # width W
+# p * 2^11, for the split Barrett quotient subtraction in canon()
+_P11_LIMBS = jnp.asarray(to_limbs(P << 11), jnp.int32)
+
+ZERO = jnp.zeros((W,), jnp.int32)
+ONE = jnp.asarray(to_limbs(1), jnp.int32)
+
+
+def _pad_last(x: jnp.ndarray, before: int, after: int) -> jnp.ndarray:
+    return jnp.pad(x, [(0, 0)] * (x.ndim - 1) + [(before, after)])
+
+
+def carry_round(x: jnp.ndarray) -> jnp.ndarray:
+    """One parallel carry round; output one limb wider. Arithmetic right
+    shift is floor division, so signed limbs are exact."""
+    h = jnp.right_shift(x, BITS)
+    l = x - jnp.left_shift(h, BITS)  # in [0, 2^BITS)
+    return _pad_last(l, 0, 1) + _pad_last(h, 1, 0)
+
+
+def carry3(x: jnp.ndarray) -> jnp.ndarray:
+    """Three parallel rounds: |entries| < 2^31 -> limbs in [-1, 2^13].
+    (Bound walk: 2^31 -> 2^13+2^18 -> 2^13+2^5+1 -> 2^13+1 -> final l+h with
+    h in [-1,1]; symmetric for negatives.)"""
+    return carry_round(carry_round(carry_round(x)))
+
+
+def _fold_round(x: jnp.ndarray) -> jnp.ndarray:
+    """Contract limbs above NLIMBS with FOLD_R and carry. Preserves value
+    mod p; shrinks |value| toward 2^390 by ~2^8.7 per round. Output width
+    input+3-ish, limbs in [-1, 2^13]."""
+    lo = x[..., :NLIMBS]
+    hi = x[..., NLIMBS:]
+    k = hi.shape[-1]
+    assert k <= _N_FOLD_ROWS
+    acc = lo
+    for s in range(0, k, _FOLD_CHUNK):
+        chunk = hi[..., s : s + _FOLD_CHUNK]
+        acc = acc + jnp.einsum(
+            "...j,jk->...k",
+            chunk,
+            FOLD_R[s : s + chunk.shape[-1], :NLIMBS],
+            preferred_element_type=jnp.int32,
+        )
+        if s + _FOLD_CHUNK < k:
+            # carry before the next chunk so the accumulator stays < 2^31
+            y = carry3(acc)
+            extra = y[..., NLIMBS:]
+            acc = y[..., :NLIMBS] + jnp.einsum(
+                "...j,jk->...k",
+                extra,
+                FOLD_R[: extra.shape[-1], :NLIMBS],
+                preferred_element_type=jnp.int32,
+            )
+    return carry3(acc)
+
+
+def _truncate(x: jnp.ndarray) -> jnp.ndarray:
+    """Drop limbs above W. Valid when |value| << 2^403 - 2^379 (callers
+    guarantee |value| < 2^400): the dropped limbs are provably zero."""
+    return x[..., :W]
+
+
+def reduce_columns(cols: jnp.ndarray) -> jnp.ndarray:
+    """Signed product columns (width <= 2W-1, |entries| < 2^31) -> lazy
+    limbs (..., W), |value| < 2^392, congruent mod p."""
+    x = carry3(cols)  # width <= 2W+2, limbs in [-1, 2^13]
+    # |v|: < 2^806 -> fold -> < 34*2^13*p ~ 2^399.8 -> < 2^391.8 -> < 2^390.2
+    x = _fold_round(x)
+    x = _fold_round(x)
+    x = _fold_round(x)
+    return _truncate(x)
+
+
+# Toeplitz gather index: TOEP_IDX[k, i] selects a_pad[k - i + W] so that
+# T[k, i] = a[k - i] (zero outside range); product columns are then one
+# batched matvec T @ b -- two HLO ops instead of W scatter-adds.
+_TOEP_IDX = np.add.outer(np.arange(2 * W - 1), -np.arange(W)) + W  # in [0, 3W-2]
+TOEP_IDX = jnp.asarray(_TOEP_IDX, jnp.int32)
+
+
+def mul_columns(a: jnp.ndarray, b: jnp.ndarray) -> jnp.ndarray:
+    """Schoolbook product columns: (..., W) x (..., W) -> (..., 2W-1), as a
+    Toeplitz-gather + batched matvec (XLA: one gather + one dot_general).
+    Requires the lazy limb invariant (limbs in [-1, 2^13]) on both inputs."""
+    a, b = jnp.broadcast_arrays(a, b)
+    a_pad = _pad_last(a, W, W - 1)  # a_pad[j] = a[j - W]
+    t = a_pad[..., TOEP_IDX]  # (..., 2W-1, W)
+    return jnp.einsum("...ki,...i->...k", t, b, preferred_element_type=jnp.int32)
+
+
+def mul(a: jnp.ndarray, b: jnp.ndarray) -> jnp.ndarray:
+    """Fp multiply: lazy in, lazy out."""
+    return reduce_columns(mul_columns(a, b))
+
+
+def sq(a: jnp.ndarray) -> jnp.ndarray:
+    return mul(a, a)
+
+
+def _norm(x: jnp.ndarray) -> jnp.ndarray:
+    """Renormalize small-column results (|entries| < 2^31, |value| < 2^398)
+    back to the lazy invariant."""
+    x = carry3(x)
+    x = _fold_round(x)
+    return _truncate(x)
+
+
+def add(a: jnp.ndarray, b: jnp.ndarray) -> jnp.ndarray:
+    return _norm(a + b)
+
+
+def sub(a: jnp.ndarray, b: jnp.ndarray) -> jnp.ndarray:
+    return _norm(a - b)
+
+
+def neg(a: jnp.ndarray) -> jnp.ndarray:
+    return _norm(-a)
+
+
+def mul_small(a: jnp.ndarray, k: int) -> jnp.ndarray:
+    """Multiply by a small integer constant, |k| <= 64 (keeps |value| < 2^398,
+    the _norm precondition)."""
+    assert abs(k) <= 64
+    return _norm(a * jnp.int32(k))
+
+
+def lincomb(terms) -> jnp.ndarray:
+    """sum(k_i * a_i) for small int constants with one normalization.
+    Requires sum(|k_i|) <= 64 (the _norm value-bound precondition)."""
+    acc = None
+    total = 0
+    for a, k in terms:
+        total += abs(k)
+        t = a * jnp.int32(k)
+        acc = t if acc is None else acc + t
+    assert total <= 64
+    return _norm(acc)
+
+
+def select(cond: jnp.ndarray, a: jnp.ndarray, b: jnp.ndarray) -> jnp.ndarray:
+    """Branchless limb select; cond is (...,) bool broadcast over limbs."""
+    return jnp.where(cond[..., None], a, b)
+
+
+# --- exact canonicalization (boundary-only) --------------------------------
+
+
+def _scan_carry(x: jnp.ndarray):
+    """Exact sequential carry: -> (limbs in [0, 2^13), signed carry_out)."""
+    xs = jnp.moveaxis(x, -1, 0)
+
+    def body(c, limb):
+        tot = limb + c
+        h = jnp.right_shift(tot, BITS)
+        return h, tot - jnp.left_shift(h, BITS)
+
+    c_out, ys = jax.lax.scan(body, jnp.zeros(x.shape[:-1], jnp.int32), xs)
+    return jnp.moveaxis(ys, 0, -1), c_out
+
+
+def _geq(x: jnp.ndarray, m: jnp.ndarray) -> jnp.ndarray:
+    """Lexicographic x >= m for canonical limb vectors in [0, 2^13)."""
+    gt = jnp.zeros(x.shape[:-1], bool)
+    lt = jnp.zeros(x.shape[:-1], bool)
+    for i in reversed(range(x.shape[-1])):
+        xi, mi = x[..., i], m[i]
+        gt = gt | (~lt & (xi > mi))
+        lt = lt | (~gt & (xi < mi))
+    return ~lt
+
+
+# Barrett: quotient q = floor(v / p) < 2^22 for v < 2^403; f32 estimate from
+# the top three limbs (weight 2^364) is within +-2 of q.
+_BARRETT_TOP = BITS * 28
+_BARRETT_INV = np.float32((2.0**_BARRETT_TOP) / float(P))
+
+
+def canon(x: jnp.ndarray) -> jnp.ndarray:
+    """Exact canonical representative in [0, p), width W (guard limb zero).
+    Input: lazy limbs, |value| < 2^399. Boundary use only (lax.scan inside)."""
+    assert x.shape[-1] == W
+    # absorb the signed carry-out: 2^403 mod p has fold row index W - NLIMBS
+    r_top = FOLD_R[W - NLIMBS, :W]
+    for _ in range(2):
+        l, c = _scan_carry(x)
+        x = l + c[..., None] * r_top
+    l, _ = _scan_carry(x)  # value now in [0, 2^403), carry-out zero
+    x = l
+    v_top = (
+        x[..., 30].astype(jnp.float32) * np.float32(1 << 26)
+        + x[..., 29].astype(jnp.float32) * np.float32(1 << 13)
+        + x[..., 28].astype(jnp.float32)
+    )
+    q = jnp.floor(v_top * _BARRETT_INV).astype(jnp.int32)
+    q = jnp.maximum(q - 2, 0)  # clamp to a guaranteed under-estimate
+    # split q = q_hi * 2^11 + q_lo so limb products stay < 2^25
+    q_lo = q & 0x7FF
+    q_hi = jnp.right_shift(q, 11)
+    x = x - q_lo[..., None] * P_LIMBS - q_hi[..., None] * _P11_LIMBS
+    l, _ = _scan_carry(x)  # remainder in [0, 5p): carry-out zero
+    x = l
+    for _ in range(4):  # at most four conditional subtractions
+        ge = _geq(x, P_LIMBS)
+        x = jnp.where(ge[..., None], x - P_LIMBS, x)
+        x, _ = _scan_carry(x)
+    return x
+
+
+def eq(a: jnp.ndarray, b: jnp.ndarray) -> jnp.ndarray:
+    """Exact Fp equality of lazy representations -> (...,) bool."""
+    return jnp.all(canon(sub(a, b)) == 0, axis=-1)
+
+
+def is_zero(a: jnp.ndarray) -> jnp.ndarray:
+    return jnp.all(canon(a) == 0, axis=-1)
+
+
+def from_int(x: int) -> jnp.ndarray:
+    return jnp.asarray(to_limbs(x % P), jnp.int32)
+
+
+def to_fp_int(a) -> int:
+    """Host: limb vector -> canonical int in [0, p)."""
+    return to_int(np.asarray(a)) % P

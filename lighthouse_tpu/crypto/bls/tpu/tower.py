@@ -1,0 +1,401 @@
+"""Extension-field towers Fp2/Fp6/Fp12 on the TPU limb representation.
+
+Layouts (limbs always last, batch axes lead):
+    Fp2  : (..., 2, W)           c0 + c1*u,          u^2 = -1
+    Fp6  : (..., 3, 2, W)        c0 + c1*v + c2*v^2, v^3 = 1+u
+    Fp12 : (..., 2, 3, 2, W)     c0 + c1*w,          w^2 = v
+
+Same tower as the oracle (fields_ref.py) and blst. All ops broadcast over
+leading batch axes. Frobenius / psi coefficients are computed on host from
+the primary parameters (via the oracle) and baked in as device constants.
+
+Static-exponent powers (inversion, sqrt) run as lax.scan over a compile-time
+bit table: one square always + one multiply under select per bit, keeping
+compiled program size independent of exponent length.
+
+Differentially tested against the oracle in tests/test_tpu_tower.py.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+from ..constants import P
+from ..fields_ref import Fp2 as RefFp2
+from . import limbs as L
+
+W = L.W
+
+
+# --- host <-> device conversion -------------------------------------------
+
+
+def fp2_from_ints(c0: int, c1: int) -> np.ndarray:
+    return np.stack([L.to_limbs(c0 % P), L.to_limbs(c1 % P)])
+
+
+def fp2_pack(vals) -> jnp.ndarray:
+    """[(c0, c1), ...] -> (n, 2, W) device array."""
+    return jnp.asarray(np.stack([fp2_from_ints(a, b) for a, b in vals]), jnp.int32)
+
+
+def fp2_to_ints(a) -> tuple[int, int]:
+    a = np.asarray(a)
+    return L.to_fp_int(a[0]), L.to_fp_int(a[1])
+
+
+def fp12_pack_ref(x) -> np.ndarray:
+    """Oracle Fp12 -> (2, 3, 2, W) numpy array."""
+    out = np.zeros((2, 3, 2, W), np.int32)
+    for i, c6 in enumerate((x.c0, x.c1)):
+        for j, c2 in enumerate((c6.c0, c6.c1, c6.c2)):
+            out[i, j, 0] = L.to_limbs(c2.c0.n)
+            out[i, j, 1] = L.to_limbs(c2.c1.n)
+    return out
+
+
+def fp12_to_ref(a):
+    """(2, 3, 2, W) -> oracle Fp12 (host, for differential tests)."""
+    from ..fields_ref import Fp12 as RefFp12, Fp6 as RefFp6
+
+    a = np.asarray(a)
+
+    def f2(x):
+        return RefFp2(L.to_fp_int(x[0]), L.to_fp_int(x[1]))
+
+    def f6(x):
+        return RefFp6(f2(x[0]), f2(x[1]), f2(x[2]))
+
+    return RefFp12(f6(a[0]), f6(a[1]))
+
+
+# --- Fp2 -------------------------------------------------------------------
+
+
+def fp2_add(a, b):
+    return L.add(a, b)
+
+
+def fp2_sub(a, b):
+    return L.sub(a, b)
+
+
+def fp2_neg(a):
+    return L.neg(a)
+
+
+def fp2_mul(a, b):
+    """Karatsuba: 3 Fp muls."""
+    a0, a1 = a[..., 0, :], a[..., 1, :]
+    b0, b1 = b[..., 0, :], b[..., 1, :]
+    t0 = L.mul(a0, b0)
+    t1 = L.mul(a1, b1)
+    t2 = L.mul(L.add(a0, a1), L.add(b0, b1))
+    return jnp.stack([L.sub(t0, t1), L.sub(L.sub(t2, t0), t1)], axis=-2)
+
+
+def fp2_sq(a):
+    """(a0+a1 u)^2 = (a0+a1)(a0-a1) + 2 a0 a1 u: 2 Fp muls."""
+    a0, a1 = a[..., 0, :], a[..., 1, :]
+    t = L.mul(a0, a1)
+    c0 = L.mul(L.add(a0, a1), L.sub(a0, a1))
+    return jnp.stack([c0, L.add(t, t)], axis=-2)
+
+
+def fp2_conj(a):
+    return jnp.stack([a[..., 0, :], L.neg(a[..., 1, :])], axis=-2)
+
+
+def fp2_mul_by_xi(a):
+    """Multiply by xi = 1 + u: (c0 - c1) + (c0 + c1) u."""
+    a0, a1 = a[..., 0, :], a[..., 1, :]
+    return jnp.stack([L.sub(a0, a1), L.add(a0, a1)], axis=-2)
+
+
+def fp2_mul_small(a, k: int):
+    return L.mul_small(a, k)
+
+
+def fp2_mul_fp(a, s):
+    """Fp2 x Fp scalar (s: (..., W))."""
+    return jnp.stack(
+        [L.mul(a[..., 0, :], s), L.mul(a[..., 1, :], s)], axis=-2
+    )
+
+
+def fp2_select(cond, a, b):
+    return jnp.where(cond[..., None, None], a, b)
+
+
+def fp2_eq(a, b):
+    return L.eq(a[..., 0, :], b[..., 0, :]) & L.eq(a[..., 1, :], b[..., 1, :])
+
+
+def fp2_is_zero(a):
+    return L.is_zero(a[..., 0, :]) & L.is_zero(a[..., 1, :])
+
+
+def fp2_zero(shape=()) -> jnp.ndarray:
+    return jnp.zeros(shape + (2, W), jnp.int32)
+
+
+def fp2_one(shape=()) -> jnp.ndarray:
+    o = jnp.zeros(shape + (2, W), jnp.int32)
+    return o.at[..., 0, :].set(L.ONE)
+
+
+# --- static-exponent Fp power (scan over compile-time bits) ---------------
+
+
+def _bits_msb_first(e: int) -> np.ndarray:
+    return np.array([int(b) for b in bin(e)[2:]], np.bool_)
+
+
+def fp_pow_static(a: jnp.ndarray, e: int) -> jnp.ndarray:
+    """a^e for a compile-time exponent e >= 1; lax.scan, 2 muls/bit."""
+    bits = jnp.asarray(_bits_msb_first(e))
+
+    def body(acc, bit):
+        acc = L.sq(acc)
+        return L.select(bit, L.mul(acc, a), acc), None
+
+    init = jnp.broadcast_to(L.ONE, a.shape)
+    out, _ = jax.lax.scan(body, init, bits)
+    return out
+
+
+def fp_inv(a: jnp.ndarray) -> jnp.ndarray:
+    """Fermat inversion a^(p-2); a == 0 maps to 0 (callers gate zeros)."""
+    return fp_pow_static(a, P - 2)
+
+
+def fp_sqrt(a: jnp.ndarray):
+    """Candidate sqrt a^((p+1)/4) (p = 3 mod 4); returns (root, is_square)."""
+    r = fp_pow_static(a, (P + 1) // 4)
+    ok = L.eq(L.sq(r), a)
+    return r, ok
+
+
+def fp_batch_inv(x: jnp.ndarray, axis: int = 0) -> jnp.ndarray:
+    """Montgomery batch inversion along `axis`: one Fermat inversion total,
+    two associative scans of Fp muls. Zero entries map to garbage; callers
+    must gate them (mirrors blst's precondition of nonzero inputs)."""
+    x = jnp.moveaxis(x, axis, 0)
+    prefix_incl = jax.lax.associative_scan(L.mul, x, axis=0)
+    suffix_incl = jax.lax.associative_scan(L.mul, x, axis=0, reverse=True)
+    total_inv = fp_inv(prefix_incl[-1])
+    ones = jnp.broadcast_to(L.ONE, (1,) + x.shape[1:])
+    prefix_excl = jnp.concatenate([ones, prefix_incl[:-1]], axis=0)
+    suffix_excl = jnp.concatenate([suffix_incl[1:], ones], axis=0)
+    inv = L.mul(L.mul(prefix_excl, total_inv), suffix_excl)
+    return jnp.moveaxis(inv, 0, axis)
+
+
+def fp2_inv(a: jnp.ndarray) -> jnp.ndarray:
+    """1/(a0 + a1 u) = conj(a) / (a0^2 + a1^2)."""
+    a0, a1 = a[..., 0, :], a[..., 1, :]
+    norm = L.add(L.sq(a0), L.sq(a1))
+    ninv = fp_inv(norm)
+    return jnp.stack([L.mul(a0, ninv), L.neg(L.mul(a1, ninv))], axis=-2)
+
+
+def fp2_batch_inv(a: jnp.ndarray, axis: int = 0) -> jnp.ndarray:
+    a0, a1 = a[..., 0, :], a[..., 1, :]
+    norm = L.add(L.sq(a0), L.sq(a1))
+    ninv = fp_batch_inv(norm, axis=axis)
+    return jnp.stack([L.mul(a0, ninv), L.neg(L.mul(a1, ninv))], axis=-2)
+
+
+def fp2_pow_static(a: jnp.ndarray, e: int) -> jnp.ndarray:
+    bits = jnp.asarray(_bits_msb_first(e))
+
+    def body(acc, bit):
+        acc = fp2_sq(acc)
+        return fp2_select(bit, fp2_mul(acc, a), acc), None
+
+    out, _ = jax.lax.scan(body, fp2_one(a.shape[:-2]), bits)
+    return out
+
+
+# --- Fp6 -------------------------------------------------------------------
+
+
+def _c(a, i):
+    return a[..., i, :, :]
+
+
+def fp6_add(a, b):
+    return L.add(a, b)
+
+
+def fp6_sub(a, b):
+    return L.sub(a, b)
+
+
+def fp6_neg(a):
+    return L.neg(a)
+
+
+def fp6_mul(a, b):
+    a0, a1, a2 = _c(a, 0), _c(a, 1), _c(a, 2)
+    b0, b1, b2 = _c(b, 0), _c(b, 1), _c(b, 2)
+    t0, t1, t2 = fp2_mul(a0, b0), fp2_mul(a1, b1), fp2_mul(a2, b2)
+    c0 = fp2_add(
+        fp2_mul_by_xi(
+            fp2_sub(fp2_sub(fp2_mul(fp2_add(a1, a2), fp2_add(b1, b2)), t1), t2)
+        ),
+        t0,
+    )
+    c1 = fp2_add(
+        fp2_sub(fp2_sub(fp2_mul(fp2_add(a0, a1), fp2_add(b0, b1)), t0), t1),
+        fp2_mul_by_xi(t2),
+    )
+    c2 = fp2_add(
+        fp2_sub(fp2_sub(fp2_mul(fp2_add(a0, a2), fp2_add(b0, b2)), t0), t2), t1
+    )
+    return jnp.stack([c0, c1, c2], axis=-3)
+
+
+def fp6_sq(a):
+    """CH-SQR2 squaring: 2 fp2_sq + 3 fp2_mul (vs 6 muls for generic)."""
+    a0, a1, a2 = _c(a, 0), _c(a, 1), _c(a, 2)
+    s0 = fp2_sq(a0)
+    ab = fp2_mul(a0, a1)
+    s1 = fp2_add(ab, ab)
+    s2 = fp2_sq(fp2_add(fp2_sub(a0, a1), a2))
+    bc = fp2_mul(a1, a2)
+    s3 = fp2_add(bc, bc)
+    s4 = fp2_sq(a2)
+    c0 = fp2_add(fp2_mul_by_xi(s3), s0)
+    c1 = fp2_add(fp2_mul_by_xi(s4), s1)
+    c2 = fp2_sub(fp2_add(fp2_add(s1, s2), s3), fp2_add(s0, s4))
+    return jnp.stack([c0, c1, c2], axis=-3)
+
+
+def fp6_mul_by_v(a):
+    return jnp.stack([fp2_mul_by_xi(_c(a, 2)), _c(a, 0), _c(a, 1)], axis=-3)
+
+
+def fp6_mul_fp2(a, s):
+    """Fp6 x Fp2 scalar."""
+    return jnp.stack(
+        [fp2_mul(_c(a, 0), s), fp2_mul(_c(a, 1), s), fp2_mul(_c(a, 2), s)], axis=-3
+    )
+
+
+def fp6_inv(a):
+    a0, a1, a2 = _c(a, 0), _c(a, 1), _c(a, 2)
+    t0 = fp2_sub(fp2_sq(a0), fp2_mul_by_xi(fp2_mul(a1, a2)))
+    t1 = fp2_sub(fp2_mul_by_xi(fp2_sq(a2)), fp2_mul(a0, a1))
+    t2 = fp2_sub(fp2_sq(a1), fp2_mul(a0, a2))
+    d = fp2_add(
+        fp2_mul(a0, t0),
+        fp2_mul_by_xi(fp2_add(fp2_mul(a2, t1), fp2_mul(a1, t2))),
+    )
+    dinv = fp2_inv(d)
+    return jnp.stack(
+        [fp2_mul(t0, dinv), fp2_mul(t1, dinv), fp2_mul(t2, dinv)], axis=-3
+    )
+
+
+def fp6_zero(shape=()):
+    return jnp.zeros(shape + (3, 2, W), jnp.int32)
+
+
+def fp6_one(shape=()):
+    o = fp6_zero(shape)
+    return o.at[..., 0, 0, :].set(L.ONE)
+
+
+# --- Fp12 ------------------------------------------------------------------
+
+
+def _h(a, i):
+    return a[..., i, :, :, :]
+
+
+def fp12_mul(a, b):
+    a0, a1 = _h(a, 0), _h(a, 1)
+    b0, b1 = _h(b, 0), _h(b, 1)
+    t0 = fp6_mul(a0, b0)
+    t1 = fp6_mul(a1, b1)
+    c1 = fp6_sub(fp6_sub(fp6_mul(fp6_add(a0, a1), fp6_add(b0, b1)), t0), t1)
+    c0 = fp6_add(t0, fp6_mul_by_v(t1))
+    return jnp.stack([c0, c1], axis=-4)
+
+
+def fp12_sq(a):
+    a0, a1 = _h(a, 0), _h(a, 1)
+    t = fp6_mul(a0, a1)
+    c0 = fp6_sub(
+        fp6_sub(fp6_mul(fp6_add(a0, a1), fp6_add(a0, fp6_mul_by_v(a1))), t),
+        fp6_mul_by_v(t),
+    )
+    return jnp.stack([c0, fp6_add(t, t)], axis=-4)
+
+
+def fp12_conj(a):
+    return jnp.stack([_h(a, 0), fp6_neg(_h(a, 1))], axis=-4)
+
+
+def fp12_inv(a):
+    a0, a1 = _h(a, 0), _h(a, 1)
+    d = fp6_sub(fp6_sq(a0), fp6_mul_by_v(fp6_sq(a1)))
+    dinv = fp6_inv(d)
+    return jnp.stack(
+        [fp6_mul(a0, dinv), fp6_neg(fp6_mul(a1, dinv))], axis=-4
+    )
+
+
+def fp12_select(cond, a, b):
+    return jnp.where(cond[..., None, None, None, None], a, b)
+
+
+def fp12_eq(a, b):
+    d = L.canon(L.sub(a, b))
+    return jnp.all(d == 0, axis=(-1, -2, -3, -4))
+
+
+def fp12_zero(shape=()):
+    return jnp.zeros(shape + (2, 3, 2, W), jnp.int32)
+
+
+def fp12_one(shape=()):
+    o = fp12_zero(shape)
+    return o.at[..., 0, 0, 0, :].set(L.ONE)
+
+
+def fp12_is_one(a):
+    return fp12_eq(a, fp12_one(a.shape[:-4]))
+
+
+# Frobenius gamma constants: packed from the oracle's single source of truth.
+from ..fields_ref import FROB_GAMMA as _REF_GAMMA
+
+_GAMMA_J = jnp.asarray(
+    np.stack([fp2_from_ints(g.c0.n, g.c1.n) for g in _REF_GAMMA]), jnp.int32
+)  # (6, 2, W)
+
+
+def fp12_frobenius(a):
+    """x -> x^p: conjugate every Fp2 coefficient, multiply by gamma_j."""
+    out = []
+    for i in range(2):  # w-slot
+        coeffs = []
+        for j in range(3):  # v-slot
+            c = fp2_conj(a[..., i, j, :, :])
+            idx = 2 * j + i  # power of the underlying w-monomial
+            if idx:
+                c = fp2_mul(c, _GAMMA_J[idx])
+            coeffs.append(c)
+        out.append(jnp.stack(coeffs, axis=-3))
+    return jnp.stack(out, axis=-4)
+
+
+def fp12_frobenius_n(a, n: int):
+    for _ in range(n):
+        a = fp12_frobenius(a)
+    return a

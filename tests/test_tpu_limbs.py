@@ -1,0 +1,174 @@
+"""Differential tests: TPU limb Fp arithmetic vs python big-int ground truth.
+
+Strategy: every op is checked on (a) random field elements, (b) boundary
+values (0, 1, p-1, p, values near 2^390), and (c) adversarial lazy inputs
+with all limbs at the +-extremes of the invariant, which pin the int32
+overflow analysis in limbs.py.
+"""
+
+import numpy as np
+import pytest
+
+from lighthouse_tpu.crypto.bls.constants import P
+from lighthouse_tpu.crypto.bls.tpu import limbs as L
+
+RNG = np.random.default_rng(1234)
+
+# jitted composites, compiled once per shape and reused across tests
+import jax
+
+j_canon = jax.jit(L.canon)
+j_mul_canon = jax.jit(lambda a, b: L.canon(L.mul(a, b)))
+j_reduce = jax.jit(L.reduce_columns)
+j_carry3 = jax.jit(L.carry3)
+
+
+def rand_fp(n):
+    return [int.from_bytes(RNG.bytes(48), "big") % P for _ in range(n)]
+
+
+def batch(vals, width=L.W):
+    import jax.numpy as jnp
+
+    return jnp.asarray(np.stack([L.to_limbs(v, width) for v in vals]), jnp.int32)
+
+
+BOUNDARY = [0, 1, 2, P - 1, P - 2, P, P + 1, (1 << 390) - 1, (1 << 381) - 1, P // 2]
+
+
+class TestConversions:
+    def test_roundtrip(self):
+        for v in BOUNDARY + rand_fp(10):
+            assert L.to_int(L.to_limbs(v)) == v
+
+    def test_from_int_canon(self):
+        a = L.from_int(P + 5)
+        assert L.to_fp_int(np.asarray(a)) == 5
+
+
+class TestCarryAndReduce:
+    def test_carry3_preserves_value_and_invariant(self):
+        # adversarial: int32 extremes in every column
+        x = RNG.integers(-(2**31) + 1, 2**31 - 1, size=(64, 2 * L.W - 1), dtype=np.int64)
+        import jax.numpy as jnp
+
+        y = np.asarray(j_carry3(jnp.asarray(x, jnp.int32)))
+        for i in range(64):
+            assert L.to_int(y[i]) == sum(int(c) << (13 * j) for j, c in enumerate(x[i]))
+        assert y.min() >= -1 and y.max() <= (1 << 13)
+
+    def test_reduce_columns_adversarial(self):
+        import jax.numpy as jnp
+
+        cases = [
+            np.full((2 * L.W - 1,), 2**31 - 1, np.int64),
+            np.full((2 * L.W - 1,), -(2**31) + 1, np.int64),
+            RNG.integers(-(2**31) + 1, 2**31 - 1, size=(2 * L.W - 1,), dtype=np.int64),
+        ]
+        for c in cases:
+            val = sum(int(x) << (13 * j) for j, x in enumerate(c))
+            out = np.asarray(j_reduce(jnp.asarray(c[None], jnp.int32)))[0]
+            assert out.min() >= -1 and out.max() <= (1 << 13)
+            assert abs(L.to_int(out)) < 2**392
+            assert L.to_int(out) % P == val % P
+
+    def test_canon_matches_bigint(self):
+        vals = BOUNDARY + rand_fp(20)
+        x = batch(vals)
+        out = np.asarray(j_canon(x))
+        for i, v in enumerate(vals):
+            assert L.to_int(out[i]) == v % P, f"canon mismatch at {i}"
+
+    def test_canon_negative_and_lazy(self):
+        import jax.numpy as jnp
+
+        # lazy vectors with negative limbs: value = sum limb_i 2^13i
+        x = RNG.integers(-1, (1 << 13) + 1, size=(32, L.W), dtype=np.int64)
+        out = np.asarray(j_canon(jnp.asarray(x, jnp.int32)))
+        for i in range(32):
+            val = sum(int(c) << (13 * j) for j, c in enumerate(x[i]))
+            assert L.to_int(out[i]) == val % P
+
+
+class TestFieldOps:
+    def test_mul_random_and_boundary(self):
+        avals = BOUNDARY + rand_fp(20)
+        bvals = (BOUNDARY + rand_fp(20))[: len(avals)]
+        a, b = batch(avals), batch(bvals)
+        out = np.asarray(j_mul_canon(a, b))
+        for i, (x, y) in enumerate(zip(avals, bvals)):
+            assert L.to_int(out[i]) == (x * y) % P, f"mul mismatch at {i}"
+
+    def test_mul_chain_stays_lazy_correct(self):
+        # repeated multiplication without canon: invariant must self-sustain
+        vals = rand_fp(8)
+        a = batch(vals)
+        acc = a
+        expect = list(vals)
+        for _ in range(10):
+            acc = L.mul(acc, a)
+            arr = np.asarray(acc)
+            assert arr.min() >= -1 and arr.max() <= (1 << 13)
+            expect = [(e * v) % P for e, v in zip(expect, vals)]
+        out = np.asarray(j_canon(acc))
+        for i, e in enumerate(expect):
+            assert L.to_int(out[i]) == e
+
+    def test_add_sub_neg(self):
+        avals, bvals = rand_fp(16), rand_fp(16)
+        a, b = batch(avals), batch(bvals)
+        add_out = np.asarray(j_canon(L.add(a, b)))
+        sub_out = np.asarray(j_canon(L.sub(a, b)))
+        neg_out = np.asarray(j_canon(L.neg(a)))
+        for i, (x, y) in enumerate(zip(avals, bvals)):
+            assert L.to_int(add_out[i]) == (x + y) % P
+            assert L.to_int(sub_out[i]) == (x - y) % P
+            assert L.to_int(neg_out[i]) == (-x) % P
+
+    def test_addsub_on_lazy_extremes(self):
+        import jax.numpy as jnp
+
+        x = np.full((4, L.W), (1 << 13), np.int64)
+        y = np.full((4, L.W), -1, np.int64)
+        vx = sum(1 << (13 * j + 13) for j in range(L.W))
+        vy = -sum(1 << (13 * j) for j in range(L.W))
+        out = np.asarray(j_canon(L.add(jnp.asarray(x, jnp.int32), jnp.asarray(y, jnp.int32))))
+        assert L.to_int(out[0]) == (vx + vy) % P
+
+    def test_mul_small_and_lincomb(self):
+        vals = rand_fp(8)
+        a = batch(vals)
+        out = np.asarray(j_canon(L.mul_small(a, 12)))
+        for i, v in enumerate(vals):
+            assert L.to_int(out[i]) == (12 * v) % P
+        out = np.asarray(j_canon(L.lincomb([(a, 3), (a, -5)])))
+        for i, v in enumerate(vals):
+            assert L.to_int(out[i]) == (-2 * v) % P
+
+    def test_eq_is_zero(self):
+        vals = rand_fp(4)
+        a = batch(vals)
+        # alternate lazy representation of the SAME field elements (v + p)
+        b = batch([v + P for v in vals])
+        assert bool(np.asarray(L.eq(a, b)).all())
+        assert bool(np.asarray(L.eq(a, a)).all())
+        assert bool(np.asarray(L.is_zero(L.sub(a, b))).all())
+        assert not bool(np.asarray(L.eq(a, batch(rand_fp(4)))).any())
+
+
+class TestJitAndBatch:
+    def test_jit_compiles_and_matches(self):
+        import jax
+
+        mulj = jax.jit(L.mul)
+        avals, bvals = rand_fp(32), rand_fp(32)
+        out = np.asarray(j_canon(mulj(batch(avals), batch(bvals))))
+        for i in range(32):
+            assert L.to_int(out[i]) == (avals[i] * bvals[i]) % P
+
+    def test_leading_batch_axes(self):
+        avals = rand_fp(12)
+        a = batch(avals).reshape(3, 4, L.W)
+        out = np.asarray(j_canon(L.mul(a, a).reshape(12, L.W)))
+        for i, v in enumerate(avals):
+            assert L.to_int(out[i]) == (v * v) % P

@@ -1,0 +1,163 @@
+"""Differential tests: TPU tower arithmetic (Fp2/Fp6/Fp12) vs the oracle."""
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from lighthouse_tpu.crypto.bls.constants import P
+from lighthouse_tpu.crypto.bls.fields_ref import Fp2, Fp6, Fp12
+from lighthouse_tpu.crypto.bls.tpu import limbs as L
+from lighthouse_tpu.crypto.bls.tpu import tower as T
+
+RNG = np.random.default_rng(99)
+
+
+def rfp():
+    return int.from_bytes(RNG.bytes(48), "big") % P
+
+
+def rfp2():
+    return Fp2(rfp(), rfp())
+
+
+def rfp6():
+    return Fp6(rfp2(), rfp2(), rfp2())
+
+
+def rfp12():
+    return Fp12(rfp6(), rfp6())
+
+
+def pack2(xs):
+    return jnp.asarray(np.stack([T.fp2_from_ints(x.c0.n, x.c1.n) for x in xs]), jnp.int32)
+
+
+def pack6(xs):
+    out = np.stack(
+        [
+            np.stack([T.fp2_from_ints(c.c0.n, c.c1.n) for c in (x.c0, x.c1, x.c2)])
+            for x in xs
+        ]
+    )
+    return jnp.asarray(out, jnp.int32)
+
+
+def pack12(xs):
+    return jnp.asarray(np.stack([T.fp12_pack_ref(x) for x in xs]), jnp.int32)
+
+
+def unpack2(a):
+    a = np.asarray(a)
+    return [Fp2(*T.fp2_to_ints(a[i])) for i in range(a.shape[0])]
+
+
+def unpack12(a):
+    a = np.asarray(a)
+    return [T.fp12_to_ref(a[i]) for i in range(a.shape[0])]
+
+
+N = 6
+
+
+class TestFp2:
+    def test_mul_sq_conj_xi(self):
+        xs, ys = [rfp2() for _ in range(N)], [rfp2() for _ in range(N)]
+        a, b = pack2(xs), pack2(ys)
+        f = jax.jit(lambda a, b: (T.fp2_mul(a, b), T.fp2_sq(a), T.fp2_conj(a), T.fp2_mul_by_xi(a)))
+        mul, sq, conj, xi = f(a, b)
+        for i in range(N):
+            assert unpack2(mul)[i] == xs[i] * ys[i]
+            assert unpack2(sq)[i] == xs[i].sq()
+            assert unpack2(conj)[i] == xs[i].conj()
+            assert unpack2(xi)[i] == xs[i] * Fp2(1, 1)
+
+    def test_inv(self):
+        xs = [rfp2() for _ in range(N)]
+        out = unpack2(jax.jit(T.fp2_inv)(pack2(xs)))
+        for i in range(N):
+            assert out[i] == xs[i].inv()
+
+    def test_batch_inv(self):
+        xs = [rfp2() for _ in range(N)]
+        out = unpack2(jax.jit(T.fp2_batch_inv)(pack2(xs)))
+        for i in range(N):
+            assert out[i] == xs[i].inv()
+
+    def test_pow_static(self):
+        xs = [rfp2() for _ in range(N)]
+        e = 0xDEADBEEF12345
+        out = unpack2(jax.jit(lambda a: T.fp2_pow_static(a, e))(pack2(xs)))
+        for i in range(N):
+            assert out[i] == xs[i].pow(e)
+
+
+class TestFpExtras:
+    def test_fp_inv_sqrt(self):
+        vals = [rfp() for _ in range(N)]
+        a = jnp.asarray(np.stack([L.to_limbs(v) for v in vals]), jnp.int32)
+        inv = np.asarray(jax.jit(T.fp_inv)(a))
+        for i, v in enumerate(vals):
+            assert L.to_fp_int(inv[i]) == pow(v, P - 2, P)
+        sq_vals = [(v * v) % P for v in vals]
+        sq = jnp.asarray(np.stack([L.to_limbs(v) for v in sq_vals]), jnp.int32)
+        root, ok = jax.jit(T.fp_sqrt)(sq)
+        root = np.asarray(root)
+        assert bool(np.asarray(ok).all())
+        for i, v in enumerate(sq_vals):
+            r = L.to_fp_int(root[i])
+            assert (r * r) % P == v
+
+    def test_fp_batch_inv(self):
+        vals = [rfp() for _ in range(N)]
+        a = jnp.asarray(np.stack([L.to_limbs(v) for v in vals]), jnp.int32)
+        inv = np.asarray(jax.jit(T.fp_batch_inv)(a))
+        for i, v in enumerate(vals):
+            assert L.to_fp_int(inv[i]) == pow(v, P - 2, P)
+
+
+class TestFp6:
+    def test_mul_inv_mulv(self):
+        xs, ys = [rfp6() for _ in range(N)], [rfp6() for _ in range(N)]
+        a, b = pack6(xs), pack6(ys)
+        f = jax.jit(lambda a, b: (T.fp6_mul(a, b), T.fp6_mul_by_v(a), T.fp6_inv(a)))
+        mul, mv, inv = f(a, b)
+        for i in range(N):
+            got = T.fp12_to_ref(np.stack([np.asarray(mul)[i], np.zeros_like(np.asarray(mul)[i])]))
+            assert got.c0 == xs[i] * ys[i]
+            got_mv = T.fp12_to_ref(np.stack([np.asarray(mv)[i], np.zeros_like(np.asarray(mv)[i])]))
+            assert got_mv.c0 == xs[i].mul_by_v()
+            got_inv = T.fp12_to_ref(np.stack([np.asarray(inv)[i], np.zeros_like(np.asarray(inv)[i])]))
+            assert got_inv.c0 == xs[i].inv()
+
+
+class TestFp12:
+    def test_mul_sq_conj(self):
+        xs, ys = [rfp12() for _ in range(N)], [rfp12() for _ in range(N)]
+        a, b = pack12(xs), pack12(ys)
+        f = jax.jit(lambda a, b: (T.fp12_mul(a, b), T.fp12_sq(a), T.fp12_conj(a)))
+        mul, sq, conj = f(a, b)
+        for i in range(N):
+            assert unpack12(mul)[i] == xs[i] * ys[i]
+            assert unpack12(sq)[i] == xs[i].sq()
+            assert unpack12(conj)[i] == xs[i].conj()
+
+    def test_inv(self):
+        xs = [rfp12() for _ in range(N)]
+        out = unpack12(jax.jit(T.fp12_inv)(pack12(xs)))
+        for i in range(N):
+            assert out[i] == xs[i].inv()
+
+    def test_frobenius(self):
+        xs = [rfp12() for _ in range(N)]
+        a = pack12(xs)
+        f = jax.jit(lambda a: (T.fp12_frobenius(a), T.fp12_frobenius_n(a, 2), T.fp12_frobenius_n(a, 6)))
+        f1, f2, f6 = f(a)
+        for i in range(N):
+            assert unpack12(f1)[i] == xs[i].frobenius(1)
+            assert unpack12(f2)[i] == xs[i].frobenius(2)
+            assert unpack12(f6)[i] == xs[i].frobenius(6)
+
+    def test_eq_one(self):
+        ones = pack12([Fp12.one(), rfp12()])
+        got = np.asarray(jax.jit(T.fp12_is_one)(ones))
+        assert got[0] and not got[1]

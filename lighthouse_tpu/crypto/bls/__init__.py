@@ -1,0 +1,33 @@
+"""BLS12-381 for the TPU-native consensus framework.
+
+Layers (bottom-up), mirroring the reference's crypto/bls crate boundary
+(crypto/bls/src/lib.rs) but TPU-first:
+
+  constants.py        curve parameters (single source of truth)
+  fields_ref.py       pure-Python field towers      (oracle)
+  curve_ref.py        pure-Python group law + serde (oracle)
+  pairing_ref.py      pure-Python optimal-ate       (oracle)
+  hash_to_curve_ref.py RFC 9380 hash-to-G2          (oracle)
+  tpu/                limb kernels, towers, curve, pairing, hash-to-curve
+  backends/           pluggable verification: jax_tpu | cpu | fake
+  api.py              PublicKey/Signature/SignatureSet/verify_signature_sets
+"""
+
+from .api import (  # noqa: F401
+    AggregatePublicKey,
+    AggregateSignature,
+    BlsError,
+    INFINITY_PUBLIC_KEY,
+    INFINITY_SIGNATURE,
+    PUBLIC_KEY_BYTES_LEN,
+    PublicKey,
+    SECRET_KEY_BYTES_LEN,
+    SIGNATURE_BYTES_LEN,
+    SecretKey,
+    Signature,
+    SignatureSet,
+    get_backend_name,
+    set_backend,
+    verify,
+    verify_signature_sets,
+)

@@ -1,0 +1,274 @@
+"""Backend-pluggable BLS12-381 API (Ethereum proof-of-possession scheme).
+
+Python equivalent of the reference's pluggable trait boundary
+(crypto/bls/src/lib.rs:99-140 and generic_{public_key,signature,
+aggregate_signature,secret_key}.rs): `SecretKey`, `PublicKey`,
+`AggregatePublicKey`, `Signature`, `AggregateSignature`, `SignatureSet`,
+and the batch entry point `verify_signature_sets()`.
+
+Backends (selected via `set_backend` / LIGHTHOUSE_TPU_BLS_BACKEND, mirroring
+the reference's compile-time feature flags at crypto/bls/src/lib.rs:8-20):
+
+  * ``jax_tpu``  -- the TPU batch verifier (the blst-equivalent hot path)
+  * ``cpu``      -- pure-Python oracle pairing (the milagro-equivalent)
+  * ``fake``     -- always-valid stub (fake_crypto; state-transition tests)
+
+Keys and signatures carry their affine oracle points plus compressed bytes;
+group membership is enforced at `PublicKey` construction (the reference
+validates at decompression, generic_public_key.rs) while signatures are
+subgroup-checked inside verification (as blst.rs:72-82 does).
+"""
+
+from __future__ import annotations
+
+import os
+from dataclasses import dataclass, field
+
+from . import curve_ref as C
+from .constants import R
+from .curve_ref import DeserializeError, Point
+from .fields_ref import Fp, Fp2
+from .hash_to_curve_ref import hash_to_g2
+
+PUBLIC_KEY_BYTES_LEN = 48
+SIGNATURE_BYTES_LEN = 96
+SECRET_KEY_BYTES_LEN = 32
+
+INFINITY_PUBLIC_KEY = bytes([0xC0]) + bytes(PUBLIC_KEY_BYTES_LEN - 1)
+INFINITY_SIGNATURE = bytes([0xC0]) + bytes(SIGNATURE_BYTES_LEN - 1)
+
+
+class BlsError(ValueError):
+    pass
+
+
+def _g1_infinity() -> Point:
+    return Point(Fp.zero(), Fp.zero(), True)
+
+
+def _g2_infinity() -> Point:
+    return Point(Fp2.zero(), Fp2.zero(), True)
+
+
+class PublicKey:
+    """Validated G1 public key: on curve, in the subgroup, not infinity
+    (key-validate per the IETF BLS spec; reference generic_public_key.rs).
+    `_tpu_limbs` caches the device limb tensor (jax_tpu backend)."""
+
+    __slots__ = ("point", "_bytes", "_tpu_limbs")
+
+    def __init__(self, point: Point, compressed: bytes | None = None):
+        self.point = point
+        self._bytes = compressed
+
+    @classmethod
+    def from_bytes(cls, data: bytes) -> "PublicKey":
+        try:
+            point = C.g1_from_bytes(bytes(data))
+        except DeserializeError as e:
+            raise BlsError(f"invalid public key: {e}") from None
+        if point.inf:
+            raise BlsError("public key is the point at infinity")
+        if not C.g1_subgroup_check(point):
+            raise BlsError("public key not in the r-torsion subgroup")
+        return cls(point, bytes(data))
+
+    def to_bytes(self) -> bytes:
+        if self._bytes is None:
+            self._bytes = C.g1_to_bytes(self.point)
+        return self._bytes
+
+    def __eq__(self, o):
+        return isinstance(o, PublicKey) and self.to_bytes() == o.to_bytes()
+
+    def __hash__(self):
+        return hash(self.to_bytes())
+
+    def __repr__(self):
+        return f"PublicKey(0x{self.to_bytes().hex()[:16]}…)"
+
+
+class AggregatePublicKey:
+    """Sum of validated public keys (reference generic_aggregate_public_key.rs)."""
+
+    __slots__ = ("point",)
+
+    def __init__(self, point: Point):
+        self.point = point
+
+    @classmethod
+    def aggregate(cls, pubkeys) -> "AggregatePublicKey":
+        if not pubkeys:
+            raise BlsError("cannot aggregate an empty pubkey list")
+        acc = _g1_infinity()
+        for pk in pubkeys:
+            acc = acc + pk.point
+        return cls(acc)
+
+
+class Signature:
+    """G2 signature. Decompression validates on-curve; subgroup membership
+    is checked during verification (matching blst.rs:72-82). The point at
+    infinity is representable (empty aggregates) and never verifies."""
+
+    __slots__ = ("point", "_bytes", "_tpu_limbs")
+
+    def __init__(self, point: Point, compressed: bytes | None = None):
+        self.point = point
+        self._bytes = compressed
+
+    @classmethod
+    def from_bytes(cls, data: bytes) -> "Signature":
+        try:
+            point = C.g2_from_bytes(bytes(data))
+        except DeserializeError as e:
+            raise BlsError(f"invalid signature: {e}") from None
+        return cls(point, bytes(data))
+
+    @classmethod
+    def infinity(cls) -> "Signature":
+        return cls(_g2_infinity(), INFINITY_SIGNATURE)
+
+    def is_infinity(self) -> bool:
+        return self.point.inf
+
+    def to_bytes(self) -> bytes:
+        if self._bytes is None:
+            self._bytes = C.g2_to_bytes(self.point)
+        return self._bytes
+
+    def __eq__(self, o):
+        return isinstance(o, Signature) and self.to_bytes() == o.to_bytes()
+
+    def __hash__(self):
+        return hash(self.to_bytes())
+
+    def __repr__(self):
+        return f"Signature(0x{self.to_bytes().hex()[:16]}…)"
+
+
+class AggregateSignature:
+    """Running aggregate of signatures (reference
+    generic_aggregate_signature.rs); starts at infinity."""
+
+    __slots__ = ("point",)
+
+    def __init__(self, point: Point | None = None):
+        self.point = point if point is not None else _g2_infinity()
+
+    @classmethod
+    def aggregate(cls, sigs) -> "AggregateSignature":
+        out = cls()
+        for s in sigs:
+            out.add_assign(s)
+        return out
+
+    def add_assign(self, sig: Signature) -> None:
+        self.point = self.point + sig.point
+
+    def add_assign_aggregate(self, other: "AggregateSignature") -> None:
+        self.point = self.point + other.point
+
+    def to_signature(self) -> Signature:
+        return Signature(self.point)
+
+    def to_bytes(self) -> bytes:
+        return C.g2_to_bytes(self.point)
+
+    @classmethod
+    def from_bytes(cls, data: bytes) -> "AggregateSignature":
+        return cls(Signature.from_bytes(data).point)
+
+
+class SecretKey:
+    """Scalar secret key; signing hashes to G2 with the Ethereum DST and
+    multiplies (reference generic_secret_key.rs + impls/blst.rs sign)."""
+
+    __slots__ = ("scalar",)
+
+    def __init__(self, scalar: int):
+        if not 1 <= scalar < R:
+            raise BlsError("secret key scalar out of range")
+        self.scalar = scalar
+
+    @classmethod
+    def from_bytes(cls, data: bytes) -> "SecretKey":
+        if len(data) != SECRET_KEY_BYTES_LEN:
+            raise BlsError("secret key must be 32 bytes")
+        return cls(int.from_bytes(data, "big"))
+
+    def to_bytes(self) -> bytes:
+        return self.scalar.to_bytes(SECRET_KEY_BYTES_LEN, "big")
+
+    def public_key(self) -> PublicKey:
+        return PublicKey(C.g1_generator().mul(self.scalar))
+
+    def sign(self, message: bytes) -> Signature:
+        return Signature(hash_to_g2(bytes(message)).mul(self.scalar))
+
+
+@dataclass
+class SignatureSet:
+    """{aggregate signature, pubkeys, 32-byte message}: one
+    fast_aggregate_verify claim (reference generic_signature_set.rs:61-72)."""
+
+    signature: Signature
+    pubkeys: list = field(default_factory=list)
+    message: bytes = b""
+
+    @classmethod
+    def single_pubkey(cls, signature, pubkey, message) -> "SignatureSet":
+        return cls(signature, [pubkey], bytes(message))
+
+    @classmethod
+    def multiple_pubkeys(cls, signature, pubkeys, message) -> "SignatureSet":
+        return cls(signature, list(pubkeys), bytes(message))
+
+
+# --- backend selection ------------------------------------------------------
+
+_BACKEND = None
+_BACKEND_NAME = None
+
+
+def set_backend(name: str) -> None:
+    """Select the verification backend: 'jax_tpu', 'cpu', or 'fake'."""
+    global _BACKEND, _BACKEND_NAME
+    if name == "cpu":
+        from .backends import cpu as mod
+    elif name == "fake":
+        from .backends import fake as mod
+    elif name == "jax_tpu":
+        from .backends import jax_tpu as mod
+    else:
+        raise BlsError(f"unknown BLS backend {name!r}")
+    _BACKEND, _BACKEND_NAME = mod, name
+
+
+def get_backend_name() -> str:
+    _ensure_backend()
+    return _BACKEND_NAME
+
+
+def _ensure_backend():
+    if _BACKEND is None:
+        set_backend(os.environ.get("LIGHTHOUSE_TPU_BLS_BACKEND", "jax_tpu"))
+    return _BACKEND
+
+
+def verify_signature_sets(sets, seed: int | None = None) -> bool:
+    """Batch-verify: every set must satisfy fast_aggregate_verify. One
+    random-linear-combination multi-pairing on capable backends (the
+    semantics of reference impls/blst.rs:36-119). `seed` pins the random
+    weights for reproducible tests."""
+    sets = list(sets)
+    if not sets:
+        return False
+    return _ensure_backend().verify_signature_sets(sets, seed=seed)
+
+
+def verify(signature: Signature, pubkeys, message: bytes) -> bool:
+    """fast_aggregate_verify of a single claim."""
+    return verify_signature_sets(
+        [SignatureSet.multiple_pubkeys(signature, pubkeys, message)]
+    )

@@ -1,0 +1,47 @@
+"""Pure-Python CPU verification backend (the milagro-equivalent fallback,
+reference crypto/bls/src/impls/milagro.rs).
+
+Same random-linear-combination batch semantics as the TPU backend, executed
+with the oracle pairing: one multi-Miller-loop product and one final
+exponentiation for the whole batch (reference impls/blst.rs:36-119).
+"""
+
+from __future__ import annotations
+
+import random
+
+from .. import curve_ref as C
+from .. import pairing_ref as PR
+from ..hash_to_curve_ref import hash_to_g2
+
+
+def _set_checks(s) -> C.Point | None:
+    """Per-set structural checks; returns the aggregate pubkey or None."""
+    if not s.pubkeys:
+        return None
+    if s.signature.point.inf:
+        return None
+    if not C.g2_subgroup_check_psi(s.signature.point):
+        return None
+    agg = None
+    for pk in s.pubkeys:
+        agg = pk.point if agg is None else agg + pk.point
+    if agg.inf:
+        return None
+    return agg
+
+
+def verify_signature_sets(sets, seed=None) -> bool:
+    rng = random.Random(seed)
+    pairs = []
+    sig_acc = None
+    for s in sets:
+        agg_pk = _set_checks(s)
+        if agg_pk is None:
+            return False
+        r = rng.getrandbits(64) | 1  # nonzero weight (blst.rs:45-57)
+        pairs.append((agg_pk.mul(r), hash_to_g2(s.message)))
+        weighted = s.signature.point.mul(r)
+        sig_acc = weighted if sig_acc is None else sig_acc + weighted
+    pairs.append((-C.g1_generator(), sig_acc))
+    return PR.multi_pairing(pairs) == PR.Fp12.one()

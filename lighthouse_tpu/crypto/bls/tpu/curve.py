@@ -1,0 +1,439 @@
+"""Batched G1/G2 Jacobian point arithmetic on the TPU limb representation.
+
+Replaces blst's POINTonE1/POINTonE2 C/assembly group law (the code behind
+reference crypto/bls/src/impls/blst.rs aggregation at blst.rs:100-106 and the
+subgroup checks at blst.rs:72-82) with branchless, batch-first kernels:
+
+  * Points are stacked Jacobian coordinate arrays -- G1: (..., 3, W),
+    G2: (..., 3, 2, W) -- limbs last, batch axes leading. Infinity is Z == 0,
+    so doubling is exception-free and addition handles infinity by select.
+  * One generic group law is instantiated over both fields through a tiny
+    field-ops namespace (`FP`, `FP2`); no per-curve duplication.
+  * Scalar multiplication is a `lax.scan` double-and-add over either a
+    compile-time exponent (subgroup checks, cofactors) or runtime 64-bit
+    scalars (the random-linear-combination weights of batch verification,
+    reference blst.rs:45-57) -- constant program size, fully batched.
+  * The exceptional add cases (P == Q, P == -Q) are resolved branchlessly:
+    exact zero tests of H and r via canonicalization, then select between
+    the add result, the doubling result, and infinity.
+  * psi (untwist-Frobenius-twist) acts coordinate-wise on Jacobian points,
+    giving the fast G2 subgroup check psi(P) == [x]P (blst's check; oracle
+    cross-validated in curve_ref.g2_subgroup_check_psi).
+
+Differentially tested against the pure-Python oracle (curve_ref.py) in
+tests/test_tpu_curve.py.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+from ..constants import BLS_X, G1_X, G1_Y, G2_X, G2_Y, P, R
+from ..curve_ref import Point, _PSI_CX, _PSI_CY
+from ..fields_ref import Fp, Fp2
+from . import limbs as L
+from . import tower as T
+
+W = L.W
+
+
+# --- field-ops namespaces ---------------------------------------------------
+
+
+class FP:
+    """Fp coordinate ops for stacked G1 points (..., 3, W)."""
+
+    coord_ndim = 1  # trailing dims of one field element
+
+    mul = staticmethod(L.mul)
+    sq = staticmethod(L.sq)
+    add = staticmethod(L.add)
+    sub = staticmethod(L.sub)
+    neg = staticmethod(L.neg)
+    mul_small = staticmethod(L.mul_small)
+    is_zero = staticmethod(L.is_zero)
+    eq = staticmethod(L.eq)
+
+    @staticmethod
+    def one(shape=()):
+        return jnp.broadcast_to(L.ONE, shape + (W,))
+
+    @staticmethod
+    def zero(shape=()):
+        return jnp.zeros(shape + (W,), jnp.int32)
+
+    @staticmethod
+    def select(cond, a, b):
+        return jnp.where(cond[..., None], a, b)
+
+
+class FP2:
+    """Fp2 coordinate ops for stacked G2 points (..., 3, 2, W)."""
+
+    coord_ndim = 2
+
+    mul = staticmethod(T.fp2_mul)
+    sq = staticmethod(T.fp2_sq)
+    add = staticmethod(T.fp2_add)
+    sub = staticmethod(T.fp2_sub)
+    neg = staticmethod(T.fp2_neg)
+    mul_small = staticmethod(T.fp2_mul_small)
+    is_zero = staticmethod(T.fp2_is_zero)
+    eq = staticmethod(T.fp2_eq)
+    one = staticmethod(T.fp2_one)
+    zero = staticmethod(T.fp2_zero)
+    select = staticmethod(T.fp2_select)
+
+
+def _coord(p, i, F):
+    return p[(Ellipsis, i) + (slice(None),) * F.coord_ndim]
+
+
+def _pack(x, y, z, F):
+    return jnp.stack([x, y, z], axis=-(F.coord_ndim + 1))
+
+
+def point_select(cond, a, b, F):
+    return jnp.where(cond[(Ellipsis,) + (None,) * (F.coord_ndim + 1)], a, b)
+
+
+def is_infinity(p, F):
+    return F.is_zero(_coord(p, 2, F))
+
+
+def infinity(F, shape=()):
+    """Jacobian infinity (1, 1, 0) -- a valid exception-free doubling input."""
+    return _pack(F.one(shape), F.one(shape), F.zero(shape), F)
+
+
+# --- generic Jacobian group law (curve y^2 = x^3 + b, a = 0) ---------------
+
+
+def double(p, F):
+    """dbl-2009-l, exception-free for a = 0: Z == 0 or Y == 0 -> Z3 == 0."""
+    x, y, z = _coord(p, 0, F), _coord(p, 1, F), _coord(p, 2, F)
+    a = F.sq(x)
+    b = F.sq(y)
+    c = F.sq(b)
+    d = F.mul_small(F.sub(F.sub(F.sq(F.add(x, b)), a), c), 2)
+    e = F.mul_small(a, 3)
+    f = F.sq(e)
+    x3 = F.sub(f, F.mul_small(d, 2))
+    y3 = F.sub(F.mul(e, F.sub(d, x3)), F.mul_small(c, 8))
+    z3 = F.mul(F.mul_small(y, 2), z)
+    return _pack(x3, y3, z3, F)
+
+
+def add(p, q, F):
+    """Complete Jacobian add: add-2007-bl with branchless resolution of the
+    exceptional cases (either input at infinity; P == Q; P == -Q)."""
+    x1, y1, z1 = _coord(p, 0, F), _coord(p, 1, F), _coord(p, 2, F)
+    x2, y2, z2 = _coord(q, 0, F), _coord(q, 1, F), _coord(q, 2, F)
+    z1z1 = F.sq(z1)
+    z2z2 = F.sq(z2)
+    u1 = F.mul(x1, z2z2)
+    u2 = F.mul(x2, z1z1)
+    s1 = F.mul(F.mul(y1, z2), z2z2)
+    s2 = F.mul(F.mul(y2, z1), z1z1)
+    h = F.sub(u2, u1)
+    r = F.sub(s2, s1)
+    i = F.sq(F.mul_small(h, 2))
+    j = F.mul(h, i)
+    r2 = F.mul_small(r, 2)
+    v = F.mul(u1, i)
+    x3 = F.sub(F.sub(F.sq(r2), j), F.mul_small(v, 2))
+    y3 = F.sub(F.mul(r2, F.sub(v, x3)), F.mul_small(F.mul(s1, j), 2))
+    z3 = F.mul(F.mul(F.sub(F.sub(F.sq(F.add(z1, z2)), z1z1), z2z2), h), F.one())
+    out = _pack(x3, y3, z3, F)
+
+    p_inf = is_infinity(p, F)
+    q_inf = is_infinity(q, F)
+    h_zero = F.is_zero(h)
+    r_zero = F.is_zero(r)
+    # same x, same y -> double; same x, opposite y -> infinity
+    out = point_select(h_zero & ~p_inf & ~q_inf, double(p, F), out, F)
+    out = point_select(
+        h_zero & ~r_zero & ~p_inf & ~q_inf, infinity(F, p_inf.shape), out, F
+    )
+    out = point_select(q_inf, p, out, F)
+    out = point_select(p_inf, q, out, F)
+    return out
+
+
+def add_mixed(p, q_aff, q_inf, F):
+    """Jacobian + affine (madd-2007-bl): q_aff = (x2, y2) stacked (..., 2, ...),
+    q_inf a bool mask. Saves the Z2 work in scalar-mul ladders."""
+    x1, y1, z1 = _coord(p, 0, F), _coord(p, 1, F), _coord(p, 2, F)
+    x2, y2 = _coord(q_aff, 0, F), _coord(q_aff, 1, F)
+    z1z1 = F.sq(z1)
+    u2 = F.mul(x2, z1z1)
+    s2 = F.mul(F.mul(y2, z1), z1z1)
+    h = F.sub(u2, x1)
+    r = F.sub(s2, y1)
+    i = F.sq(F.mul_small(h, 2))
+    j = F.mul(h, i)
+    r2 = F.mul_small(r, 2)
+    v = F.mul(x1, i)
+    x3 = F.sub(F.sub(F.sq(r2), j), F.mul_small(v, 2))
+    y3 = F.sub(F.mul(r2, F.sub(v, x3)), F.mul_small(F.mul(y1, j), 2))
+    z3 = F.mul(F.sub(F.sq(F.add(z1, h)), F.add(z1z1, F.sq(h))), F.one())
+    out = _pack(x3, y3, z3, F)
+
+    p_inf = is_infinity(p, F)
+    h_zero = F.is_zero(h)
+    r_zero = F.is_zero(r)
+    out = point_select(h_zero & ~p_inf & ~q_inf, double(p, F), out, F)
+    out = point_select(
+        h_zero & ~r_zero & ~p_inf & ~q_inf, infinity(F, p_inf.shape), out, F
+    )
+    q_jac = _pack(x2, y2, F.one(x2.shape[: x2.ndim - F.coord_ndim]), F)
+    out = point_select(p_inf & ~q_inf, q_jac, out, F)
+    out = point_select(p_inf & q_inf, p, out, F)
+    out = point_select(q_inf & ~p_inf, p, out, F)
+    return out
+
+
+def neg(p, F):
+    x, y, z = _coord(p, 0, F), _coord(p, 1, F), _coord(p, 2, F)
+    return _pack(x, F.neg(y), z, F)
+
+
+def eq(p, q, F):
+    """Jacobian equality: X1 Z2^2 == X2 Z1^2 and Y1 Z2^3 == Y2 Z1^3, with
+    infinity equal only to infinity."""
+    x1, y1, z1 = _coord(p, 0, F), _coord(p, 1, F), _coord(p, 2, F)
+    x2, y2, z2 = _coord(q, 0, F), _coord(q, 1, F), _coord(q, 2, F)
+    z1z1, z2z2 = F.sq(z1), F.sq(z2)
+    same_x = F.eq(F.mul(x1, z2z2), F.mul(x2, z1z1))
+    same_y = F.eq(F.mul(F.mul(y1, z2), z2z2), F.mul(F.mul(y2, z1), z1z1))
+    p_inf, q_inf = is_infinity(p, F), is_infinity(q, F)
+    return (p_inf & q_inf) | (~p_inf & ~q_inf & same_x & same_y)
+
+
+# --- scalar multiplication --------------------------------------------------
+
+
+def scalar_mul_static(p, e: int, F):
+    """[e]P for a compile-time e >= 0: lax.scan over the bits (MSB first)."""
+    if e == 0:
+        return infinity(F, p.shape[: p.ndim - F.coord_ndim - 1])
+    bits = jnp.asarray(np.array([int(b) for b in bin(e)[2:]], np.bool_))
+
+    def body(acc, bit):
+        acc = double(acc, F)
+        return point_select(bit, add(acc, p, F), acc, F), None
+
+    init = infinity(F, p.shape[: p.ndim - F.coord_ndim - 1])
+    out, _ = jax.lax.scan(body, init, bits)
+    return out
+
+
+def scalar_mul_u64(p, scalars, F):
+    """[s]P for runtime 64-bit scalars (the batch-verify random weights).
+
+    scalars: (...,) uint64-valued array given as (..., 2) uint32 (hi, lo).
+    Runs a 64-iteration MSB-first double-and-add ladder under lax.scan.
+    """
+    hi = scalars[..., 0]
+    lo = scalars[..., 1]
+    word = jnp.stack([hi, lo], axis=0)  # (2, ...)
+
+    def bit_at(k):  # k in [0, 64), MSB first
+        w = word[k // 32]
+        return ((w >> jnp.uint32(31 - (k % 32))) & jnp.uint32(1)) != 0
+
+    bits = jnp.stack([bit_at(k) for k in range(64)], axis=0)  # (64, ...)
+
+    def body(acc, bit):
+        acc = double(acc, F)
+        return point_select(bit, add(acc, p, F), acc, F), None
+
+    init = infinity(F, p.shape[: p.ndim - F.coord_ndim - 1])
+    out, _ = jax.lax.scan(body, init, bits)
+    return out
+
+
+# --- affine conversion ------------------------------------------------------
+
+
+def to_affine_g1(p):
+    """Batched Jacobian -> affine for G1 (one Fermat inversion total via
+    Montgomery batch inversion). Infinity maps to (0, 0) + mask."""
+    x, y, z = p[..., 0, :], p[..., 1, :], p[..., 2, :]
+    inf = L.is_zero(z)
+    z_safe = L.select(inf, jnp.broadcast_to(L.ONE, z.shape), z)
+    flat = z_safe.reshape(-1, W)
+    zinv = T.fp_batch_inv(flat, axis=0).reshape(z.shape)
+    zinv2 = L.sq(zinv)
+    ax = L.mul(x, zinv2)
+    ay = L.mul(y, L.mul(zinv2, zinv))
+    zero = jnp.zeros_like(ax)
+    return (
+        jnp.stack([L.select(inf, zero, ax), L.select(inf, zero, ay)], axis=-2),
+        inf,
+    )
+
+
+def to_affine_g2(p):
+    x, y, z = p[..., 0, :, :], p[..., 1, :, :], p[..., 2, :, :]
+    inf = T.fp2_is_zero(z)
+    z_safe = T.fp2_select(inf, T.fp2_one(z.shape[:-2]), z)
+    flat = z_safe.reshape(-1, 2, W)
+    zinv = T.fp2_batch_inv(flat, axis=0).reshape(z.shape)
+    zinv2 = T.fp2_sq(zinv)
+    ax = T.fp2_mul(x, zinv2)
+    ay = T.fp2_mul(y, T.fp2_mul(zinv2, zinv))
+    zero = jnp.zeros_like(ax)
+    return (
+        jnp.stack(
+            [T.fp2_select(inf, zero, ax), T.fp2_select(inf, zero, ay)], axis=-3
+        ),
+        inf,
+    )
+
+
+def from_affine(aff, inf, F):
+    """(..., 2, coord) affine + inf mask -> Jacobian; infinity -> (1, 1, 0)."""
+    x, y = _coord(aff, 0, F), _coord(aff, 1, F)
+    shape = inf.shape
+    z = F.select(inf, F.zero(shape), F.one(shape))
+    one = F.one(shape)
+    return _pack(F.select(inf, one, x), F.select(inf, one, y), z, F)
+
+
+# --- host <-> device --------------------------------------------------------
+
+
+def g1_pack(points) -> jnp.ndarray:
+    """Oracle affine G1 points -> (n, 3, W) Jacobian device array."""
+    out = np.zeros((len(points), 3, W), np.int32)
+    for i, pt in enumerate(points):
+        if pt.inf:
+            out[i, 0] = L.to_limbs(1)
+            out[i, 1] = L.to_limbs(1)
+        else:
+            out[i, 0] = L.to_limbs(pt.x.n)
+            out[i, 1] = L.to_limbs(pt.y.n)
+            out[i, 2] = L.to_limbs(1)
+    return jnp.asarray(out)
+
+
+def g2_pack(points) -> jnp.ndarray:
+    """Oracle affine G2 points -> (n, 3, 2, W) Jacobian device array."""
+    out = np.zeros((len(points), 3, 2, W), np.int32)
+    for i, pt in enumerate(points):
+        if pt.inf:
+            out[i, 0, 0] = L.to_limbs(1)
+            out[i, 1, 0] = L.to_limbs(1)
+        else:
+            out[i, 0, 0] = L.to_limbs(pt.x.c0.n)
+            out[i, 0, 1] = L.to_limbs(pt.x.c1.n)
+            out[i, 1, 0] = L.to_limbs(pt.y.c0.n)
+            out[i, 1, 1] = L.to_limbs(pt.y.c1.n)
+            out[i, 2, 0] = L.to_limbs(1)
+    return jnp.asarray(out)
+
+
+def g1_unpack(p) -> list:
+    """(n, 3, W) Jacobian device array -> oracle affine points (host)."""
+    aff, inf = to_affine_g1(p)
+    aff, inf = np.asarray(aff), np.asarray(inf)
+    out = []
+    for i in range(aff.shape[0]):
+        if inf[i]:
+            out.append(Point(Fp(0), Fp(0), True))
+        else:
+            out.append(
+                Point(Fp(L.to_fp_int(aff[i, 0])), Fp(L.to_fp_int(aff[i, 1])))
+            )
+    return out
+
+
+def g2_unpack(p) -> list:
+    aff, inf = to_affine_g2(p)
+    aff, inf = np.asarray(aff), np.asarray(inf)
+    out = []
+    for i in range(aff.shape[0]):
+        if inf[i]:
+            out.append(Point(Fp2.zero(), Fp2.zero(), True))
+        else:
+            x = Fp2(L.to_fp_int(aff[i, 0, 0]), L.to_fp_int(aff[i, 0, 1]))
+            y = Fp2(L.to_fp_int(aff[i, 1, 0]), L.to_fp_int(aff[i, 1, 1]))
+            out.append(Point(x, y))
+    return out
+
+
+# --- psi endomorphism & subgroup checks ------------------------------------
+
+# psi coefficients from the oracle's derivation (curve_ref.py:107-108).
+_PSI_CX_DEV = jnp.asarray(T.fp2_from_ints(_PSI_CX.c0.n, _PSI_CX.c1.n))
+_PSI_CY_DEV = jnp.asarray(T.fp2_from_ints(_PSI_CY.c0.n, _PSI_CY.c1.n))
+
+_X_ABS = -BLS_X
+
+
+def psi(p):
+    """Jacobian psi: (cx conj(X), cy conj(Y), conj(Z)) -- conjugation
+    commutes with the Jacobian scaling, so no normalization is needed."""
+    x, y, z = p[..., 0, :, :], p[..., 1, :, :], p[..., 2, :, :]
+    return jnp.stack(
+        [
+            T.fp2_mul(T.fp2_conj(x), _PSI_CX_DEV),
+            T.fp2_mul(T.fp2_conj(y), _PSI_CY_DEV),
+            T.fp2_conj(z),
+        ],
+        axis=-3,
+    )
+
+
+def g2_subgroup_check(p) -> jnp.ndarray:
+    """P in G2 iff psi(P) == [x]P (x < 0: [x]P = -[|x|]P). The fast check
+    blst performs (blst.rs:72-82); oracle-validated."""
+    xp = neg(scalar_mul_static(p, _X_ABS, FP2), FP2)
+    return eq(psi(p), xp, FP2) | is_infinity(p, FP2)
+
+
+def g1_subgroup_check(p) -> jnp.ndarray:
+    """Definitional [r]P == O. Runs once per pubkey at cache-build time (the
+    reference's ValidatorPubkeyCache boundary), not in the per-batch path."""
+    return is_infinity(scalar_mul_static(p, R, FP), FP)
+
+
+def on_curve_g1(p) -> jnp.ndarray:
+    """Y^2 == X^3 + 4 Z^6 (Jacobian form); infinity passes."""
+    x, y, z = p[..., 0, :], p[..., 1, :], p[..., 2, :]
+    z2 = L.sq(z)
+    lhs = L.sq(y)
+    rhs = L.add(L.mul(L.sq(x), x), L.mul_small(L.mul(L.sq(z2), z2), 4))
+    return L.eq(lhs, rhs) | is_infinity(p, FP)
+
+
+def on_curve_g2(p) -> jnp.ndarray:
+    """Y^2 == X^3 + (4 + 4u) Z^6; infinity passes."""
+    x, y, z = p[..., 0, :, :], p[..., 1, :, :], p[..., 2, :, :]
+    z2 = T.fp2_sq(z)
+    z6 = T.fp2_mul(T.fp2_sq(z2), z2)
+    b = T.fp2_mul_by_xi(T.fp2_mul_small(z6, 4))  # (4 + 4u) z^6
+    lhs = T.fp2_sq(y)
+    rhs = T.fp2_add(T.fp2_mul(T.fp2_sq(x), x), b)
+    return T.fp2_eq(lhs, rhs) | is_infinity(p, FP2)
+
+
+# --- generators -------------------------------------------------------------
+
+G1_GEN = jnp.asarray(
+    np.stack([L.to_limbs(G1_X), L.to_limbs(G1_Y), L.to_limbs(1)])
+)  # (3, W)
+
+G2_GEN = jnp.asarray(
+    np.stack(
+        [
+            T.fp2_from_ints(*G2_X),
+            T.fp2_from_ints(*G2_Y),
+            T.fp2_from_ints(1, 0),
+        ]
+    )
+)  # (3, 2, W)

@@ -1,0 +1,11 @@
+"""Multi-chip parallelism for the TPU framework.
+
+The reference's concurrency inventory (SURVEY.md section 2.5) maps here:
+rayon batch map-reduce -> sharded batch kernels over a `jax.sharding.Mesh`
+with XLA collectives on ICI; the p2p fabric stays host-side.
+"""
+
+from .verify_sharded import (  # noqa: F401
+    make_sharded_verify,
+    sets_mesh,
+)

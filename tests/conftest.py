@@ -8,11 +8,20 @@ Real-TPU execution happens only in bench.py.
 import os
 import sys
 
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
+# FORCE cpu: the ambient environment may point JAX at a remote TPU tunnel
+# (JAX_PLATFORMS=axon), where unjitted op-by-op dispatch pays a network
+# round trip per primitive -- the test suite must be local and hermetic.
+# The axon sitecustomize imports jax at interpreter startup, so the env var
+# is already captured; override through the live config instead.
+os.environ["JAX_PLATFORMS"] = "cpu"
 flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in flags:
     os.environ["XLA_FLAGS"] = (
         flags + " --xla_force_host_platform_device_count=8"
     ).strip()
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))

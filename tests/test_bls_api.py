@@ -1,0 +1,127 @@
+"""Backend-pluggable BLS API tests, run against ALL backends.
+
+Mirrors the reference's strategy of running its test suite per backend
+(Makefile:109-114 runs ef_tests under blst, fake_crypto, and milagro;
+crypto/bls/tests/tests.rs test_suite! macro instantiates per backend).
+"""
+
+import random
+
+import pytest
+
+from lighthouse_tpu.crypto.bls import (
+    AggregateSignature,
+    BlsError,
+    PublicKey,
+    SecretKey,
+    Signature,
+    SignatureSet,
+    set_backend,
+    verify_signature_sets,
+)
+from lighthouse_tpu.crypto.bls.constants import R
+
+rng = random.Random(7)
+
+
+def keypair():
+    sk = SecretKey(rng.randrange(1, R))
+    return sk, sk.public_key()
+
+
+@pytest.fixture(params=["cpu", "jax_tpu"])
+def backend(request):
+    set_backend(request.param)
+    yield request.param
+    set_backend("jax_tpu")
+
+
+class TestSerde:
+    def test_pubkey_round_trip(self):
+        _, pk = keypair()
+        assert PublicKey.from_bytes(pk.to_bytes()) == pk
+
+    def test_signature_round_trip(self):
+        sk, _ = keypair()
+        sig = sk.sign(b"\x11" * 32)
+        assert Signature.from_bytes(sig.to_bytes()) == sig
+
+    def test_infinity_pubkey_rejected(self):
+        from lighthouse_tpu.crypto.bls import INFINITY_PUBLIC_KEY
+
+        with pytest.raises(BlsError):
+            PublicKey.from_bytes(INFINITY_PUBLIC_KEY)
+
+    def test_infinity_signature_representable(self):
+        from lighthouse_tpu.crypto.bls import INFINITY_SIGNATURE
+
+        sig = Signature.from_bytes(INFINITY_SIGNATURE)
+        assert sig.is_infinity()
+        assert sig.to_bytes() == INFINITY_SIGNATURE
+
+
+class TestVerify:
+    def test_single_good_and_bad(self, backend):
+        sk, pk = keypair()
+        msg = b"\x22" * 32
+        sig = sk.sign(msg)
+        good = SignatureSet.single_pubkey(sig, pk, msg)
+        assert verify_signature_sets([good], seed=1)
+        bad = SignatureSet.single_pubkey(sig, pk, b"\x23" * 32)
+        assert not verify_signature_sets([bad], seed=1)
+
+    def test_fast_aggregate_verify(self, backend):
+        msg = b"\x33" * 32
+        keys = [keypair() for _ in range(4)]
+        agg = AggregateSignature.aggregate([sk.sign(msg) for sk, _ in keys])
+        s = SignatureSet.multiple_pubkeys(
+            agg.to_signature(), [pk for _, pk in keys], msg
+        )
+        assert verify_signature_sets([s], seed=2)
+        # dropping a contributor invalidates
+        s_bad = SignatureSet.multiple_pubkeys(
+            agg.to_signature(), [pk for _, pk in keys[:3]], msg
+        )
+        assert not verify_signature_sets([s_bad], seed=2)
+
+    def test_batch_mixed_sets(self, backend):
+        batch = []
+        for i in range(3):
+            sk, pk = keypair()
+            msg = bytes([i]) * 32
+            batch.append(SignatureSet.single_pubkey(sk.sign(msg), pk, msg))
+        msg = b"\x44" * 32
+        keys = [keypair() for _ in range(2)]
+        agg = AggregateSignature.aggregate([sk.sign(msg) for sk, _ in keys])
+        batch.append(
+            SignatureSet.multiple_pubkeys(
+                agg.to_signature(), [pk for _, pk in keys], msg
+            )
+        )
+        assert verify_signature_sets(batch, seed=3)
+        # one wrong signature poisons the whole batch (caller then re-splits,
+        # as reference attestation_verification/batch.rs:122-133 does)
+        sk_x, pk_x = keypair()
+        batch.append(
+            SignatureSet.single_pubkey(sk_x.sign(b"\x55" * 32), pk_x, b"\x66" * 32)
+        )
+        assert not verify_signature_sets(batch, seed=3)
+
+    def test_infinity_signature_never_verifies(self, backend):
+        _, pk = keypair()
+        s = SignatureSet.single_pubkey(Signature.infinity(), pk, b"\x00" * 32)
+        assert not verify_signature_sets([s], seed=4)
+
+    def test_empty_pubkeys_fails(self, backend):
+        sk, _ = keypair()
+        s = SignatureSet(sk.sign(b"\x01" * 32), [], b"\x01" * 32)
+        assert not verify_signature_sets([s], seed=5)
+
+    def test_fake_backend_accepts_everything(self):
+        set_backend("fake")
+        try:
+            sk, pk = keypair()
+            s = SignatureSet.single_pubkey(sk.sign(b"\x0a" * 32), pk, b"\x0b" * 32)
+            assert verify_signature_sets([s])
+        finally:
+            set_backend("jax_tpu")

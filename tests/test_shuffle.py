@@ -1,0 +1,48 @@
+"""Swap-or-not shuffle tests: vectorized list path vs the scalar spec
+algorithm, permutation properties, and spec test vectors (the shuffling
+spec-vector format the reference consumes in ef_tests cases/shuffling.rs)."""
+
+import numpy as np
+
+from lighthouse_tpu.utils.shuffle import (
+    compute_shuffled_index,
+    shuffle_indices,
+    shuffle_list,
+)
+
+SEED = bytes(range(32))
+
+
+def test_list_matches_scalar():
+    n = 100
+    perm = shuffle_indices(n, SEED)
+    for i in range(0, n, 7):
+        assert perm[i] == compute_shuffled_index(i, n, SEED)
+
+
+def test_is_permutation():
+    for n in (1, 2, 33, 257, 1000):
+        perm = shuffle_indices(n, SEED)
+        assert sorted(perm.tolist()) == list(range(n))
+
+
+def test_shuffle_list_mapping():
+    n = 64
+    items = [f"v{i}" for i in range(n)]
+    fwd = shuffle_list(items, SEED, forwards=True)
+    bwd = shuffle_list(items, SEED)  # committee direction (default)
+    for i in range(n):
+        assert fwd[compute_shuffled_index(i, n, SEED)] == items[i]
+        assert bwd[i] == items[compute_shuffled_index(i, n, SEED)]
+    # the two directions are inverse permutations of each other
+    assert sorted(fwd) == sorted(bwd) == sorted(items)
+
+
+def test_seed_sensitivity():
+    a = shuffle_indices(50, SEED)
+    b = shuffle_indices(50, bytes(32))
+    assert not np.array_equal(a, b)
+
+
+def test_zero_rounds_identity():
+    assert shuffle_indices(10, SEED, rounds=0).tolist() == list(range(10))

@@ -1,0 +1,162 @@
+"""SSZ encode/decode/hash-tree-root tests with independently-computed
+expected values (hand merkleization with hashlib), mirroring the coverage
+style of the reference's ssz round-trip tests (consensus/ssz/tests)."""
+
+import hashlib
+
+import pytest
+
+from lighthouse_tpu.ssz import (
+    Bitlist,
+    Bitvector,
+    ByteList,
+    Bytes32,
+    Bytes48,
+    List,
+    SszError,
+    Vector,
+    boolean,
+    container,
+    uint8,
+    uint16,
+    uint64,
+    ZERO_HASHES,
+)
+
+
+def sha(x):
+    return hashlib.sha256(x).digest()
+
+
+class TestBasics:
+    def test_uint_round_trip(self):
+        for t, v in [(uint8, 0x7F), (uint16, 0xABCD), (uint64, 2**63 + 5)]:
+            assert t.decode(t.encode(v)) == v
+
+    def test_uint64_encoding_little_endian(self):
+        assert uint64.encode(1) == b"\x01" + bytes(7)
+
+    def test_uint_root_padded(self):
+        assert uint64.hash_tree_root(5) == (5).to_bytes(8, "little") + bytes(24)
+
+    def test_boolean(self):
+        assert boolean.decode(b"\x01") is True
+        with pytest.raises(SszError):
+            boolean.decode(b"\x02")
+
+
+class TestSequences:
+    def test_vector_fixed_round_trip(self):
+        t = Vector(uint64, 3)
+        v = (1, 2, 3)
+        assert t.decode(t.encode(v)) == v
+
+    def test_vector_root_packs_chunks(self):
+        t = Vector(uint64, 8)  # 64 bytes -> 2 chunks
+        v = tuple(range(8))
+        data = b"".join(uint64.encode(x) for x in v)
+        want = sha(data[:32] + data[32:])
+        assert t.hash_tree_root(v) == want
+
+    def test_list_root_mixes_length(self):
+        t = List(uint64, 8)  # capacity 2 chunks
+        v = (1, 2)
+        chunk0 = b"".join(uint64.encode(x) for x in v) + bytes(16)
+        root = sha(chunk0 + bytes(32))
+        want = sha(root + (2).to_bytes(32, "little"))
+        assert t.hash_tree_root(v) == want
+
+    def test_empty_list_root(self):
+        t = List(uint64, 1024)  # 256 chunks -> depth 8
+        want = sha(ZERO_HASHES[8] + (0).to_bytes(32, "little"))
+        assert t.hash_tree_root(()) == want
+
+    def test_list_of_variable_round_trip(self):
+        t = List(ByteList(48), 4)
+        v = (b"a", b"", b"xyz")
+        assert t.decode(t.encode(v)) == v
+
+    def test_list_limit_enforced(self):
+        t = List(uint64, 2)
+        with pytest.raises(SszError):
+            t.encode((1, 2, 3))
+        with pytest.raises(SszError):
+            t.decode(b"\x01" + bytes(7) + b"\x02" + bytes(7) + b"\x03" + bytes(7))
+
+
+class TestBitfields:
+    def test_bitvector_round_trip(self):
+        t = Bitvector(10)
+        v = tuple(i % 3 == 0 for i in range(10))
+        assert t.decode(t.encode(v)) == v
+
+    def test_bitvector_rejects_padding_bits(self):
+        t = Bitvector(4)
+        with pytest.raises(SszError):
+            t.decode(b"\xff")
+
+    def test_bitlist_round_trip_various_lengths(self):
+        t = Bitlist(16)
+        for n in (0, 1, 7, 8, 9, 16):
+            v = tuple(i % 2 == 1 for i in range(n))
+            assert t.decode(t.encode(v)) == v
+
+    def test_bitlist_delimiter(self):
+        t = Bitlist(8)
+        assert t.encode(()) == b"\x01"
+        with pytest.raises(SszError):
+            t.decode(b"\x00")
+
+    def test_bitlist_root(self):
+        t = Bitlist(5)
+        v = (True, False, True)
+        chunk = b"\x05" + bytes(31)
+        want = sha(sha(chunk + bytes(32))[:32] + (3).to_bytes(32, "little"))
+        # depth for limit 5 bits = 1 chunk -> no extra level; recompute:
+        want = sha(chunk + (3).to_bytes(32, "little"))
+        assert t.hash_tree_root(v) == want
+
+
+@container
+class Inner:
+    a: uint64
+    b: Bytes32
+
+
+@container
+class Outer:
+    x: uint16
+    inner: Inner.ssz_type
+    items: List(uint64, 4)
+    flag: boolean
+
+
+class TestContainers:
+    def test_fixed_container_round_trip(self):
+        v = Inner(a=7, b=b"\x11" * 32)
+        assert Inner.from_ssz_bytes(v.as_ssz_bytes()) == v
+
+    def test_container_root_manual(self):
+        v = Inner(a=7, b=b"\x11" * 32)
+        want = sha(uint64.hash_tree_root(7) + b"\x11" * 32)
+        assert v.tree_hash_root() == want
+
+    def test_variable_container_round_trip(self):
+        v = Outer(x=3, inner=Inner(a=1, b=bytes(32)), items=(9, 8), flag=True)
+        assert Outer.from_ssz_bytes(v.as_ssz_bytes()) == v
+
+    def test_variable_container_layout(self):
+        v = Outer(x=3, inner=Inner(a=1, b=bytes(32)), items=(), flag=False)
+        data = v.as_ssz_bytes()
+        # fixed part: u16 (2) + inner (40) + offset (4) + bool (1) = 47
+        assert len(data) == 47
+        assert data[42:46] == (47).to_bytes(4, "little")
+
+    def test_defaults(self):
+        v = Outer.default()
+        assert v.x == 0 and v.items == () and v.flag is False
+
+    def test_decode_rejects_trailing(self):
+        v = Inner(a=7, b=bytes(32))
+        with pytest.raises(SszError):
+            Inner.from_ssz_bytes(v.as_ssz_bytes() + b"\x00")

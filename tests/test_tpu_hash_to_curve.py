@@ -1,0 +1,64 @@
+"""Differential tests: TPU hash-to-G2 pipeline vs the RFC 9380 oracle."""
+
+import numpy as np
+import jax.numpy as jnp
+
+from lighthouse_tpu.crypto.bls import curve_ref as C
+from lighthouse_tpu.crypto.bls import hash_to_curve_ref as HR
+from lighthouse_tpu.crypto.bls.constants import P
+from lighthouse_tpu.crypto.bls.fields_ref import Fp2
+from lighthouse_tpu.crypto.bls.tpu import curve as TC
+from lighthouse_tpu.crypto.bls.tpu import hash_to_curve as TH
+from lighthouse_tpu.crypto.bls.tpu import limbs as L
+from lighthouse_tpu.crypto.bls.tpu import tower as T
+
+import random
+
+rng = random.Random(0x5757)
+
+
+def rand_fp2s(n):
+    return [Fp2(rng.randrange(P), rng.randrange(P)) for _ in range(n)]
+
+
+def test_fp2_sqrt():
+    squares = [x.sq() for x in rand_fp2s(2)]
+    c1zero_sq = Fp2(rng.randrange(P), 0)
+    non_sq = None
+    while non_sq is None:
+        cand = rand_fp2s(1)[0]
+        if cand.sqrt() is None:
+            non_sq = cand
+    vals = squares + [c1zero_sq, non_sq]
+    dev = T.fp2_pack([(v.c0.n, v.c1.n) for v in vals])
+    root, ok = TH.fp2_sqrt(dev)
+    ok = np.asarray(ok)
+    assert ok.tolist() == [True, True, c1zero_sq.sqrt() is not None, False]
+    for i, v in enumerate(vals):
+        if ok[i]:
+            r = Fp2(*TH.T.fp2_to_ints(root[i]))
+            assert r.sq() == v
+
+
+def test_sgn0():
+    vals = rand_fp2s(3) + [Fp2(0, 5), Fp2(4, 1)]
+    dev = T.fp2_pack([(v.c0.n, v.c1.n) for v in vals])
+    got = np.asarray(TH.fp2_sgn0(dev)).astype(int).tolist()
+    assert got == [v.sgn0() for v in vals]
+
+
+def test_map_to_curve_sswu_matches_oracle():
+    us = rand_fp2s(3)
+    dev = T.fp2_pack([(u.c0.n, u.c1.n) for u in us])
+    x, y = TH.map_to_curve_sswu(dev)
+    for i, u in enumerate(us):
+        wx, wy = HR.map_to_curve_sswu_prime(u)
+        assert Fp2(*T.fp2_to_ints(x[i])) == wx
+        assert Fp2(*T.fp2_to_ints(y[i])) == wy
+
+
+def test_hash_to_g2_matches_oracle():
+    msgs = [b"", b"abc", bytes(range(32))]
+    got = TC.g2_unpack(TH.hash_to_g2(msgs))
+    want = [HR.hash_to_g2(m) for m in msgs]
+    assert got == want

@@ -1,0 +1,95 @@
+"""Differential tests: TPU Miller loop / final exponentiation vs the oracle.
+
+The TPU final exponentiation computes f^(3h) (x-chain; see pairing.py), so
+comparisons against the oracle pairing are done as cube-of-oracle.
+"""
+
+import random
+
+import numpy as np
+import jax.numpy as jnp
+
+from lighthouse_tpu.crypto.bls import curve_ref as C
+from lighthouse_tpu.crypto.bls import pairing_ref as PR
+from lighthouse_tpu.crypto.bls.constants import R
+from lighthouse_tpu.crypto.bls.fields_ref import Fp2
+from lighthouse_tpu.crypto.bls.tpu import curve as TC
+from lighthouse_tpu.crypto.bls.tpu import pairing as TP
+from lighthouse_tpu.crypto.bls.tpu import tower as T
+
+rng = random.Random(0xBEEF)
+
+
+def pack_pairs(pairs):
+    """[(P oracle G1 affine, Q oracle G2 affine)] -> device affine arrays."""
+    g1 = TC.g1_pack([p for p, _ in pairs])  # (n, 3, W) jac with z=1
+    g2 = TC.g2_pack([q for _, q in pairs])
+    p_aff = g1[:, :2]
+    q_aff = g2[:, :2]
+    p_inf = jnp.asarray([p.inf for p, _ in pairs])
+    q_inf = jnp.asarray([q.inf for _, q in pairs])
+    return p_aff, p_inf, q_aff, q_inf
+
+
+def test_miller_loop_matches_oracle():
+    g1, g2 = C.g1_generator(), C.g2_generator()
+    pairs = [
+        (g1.mul(rng.randrange(1, R)), g2.mul(rng.randrange(1, R)))
+        for _ in range(2)
+    ]
+    pairs.append((C.Point(g1.x, g1.y, True), g2))  # P at infinity -> one
+    got = TP.miller_loop(*pack_pairs(pairs))
+    for i, (p, q) in enumerate(pairs):
+        want = PR.miller_loop(p, q)
+        # Lines differ from the oracle's by Fp2 scaling factors; compare
+        # after the easy part would also work, but full final exp is the
+        # real contract -- checked in test_pairing_matches_oracle. Here we
+        # check only the infinity case exactly.
+        if p.inf or q.inf:
+            assert T.fp12_to_ref(got[i]) == want
+
+
+def test_pairing_matches_oracle_cubed():
+    g1, g2 = C.g1_generator(), C.g2_generator()
+    a, b = rng.randrange(1, R), rng.randrange(1, R)
+    pairs = [(g1, g2), (g1.mul(a), g2.mul(b))]
+    got = TP.pairing(*pack_pairs(pairs))
+    for i, (p, q) in enumerate(pairs):
+        want = PR.pairing(p, q).pow(3)
+        assert T.fp12_to_ref(got[i]) == want
+
+
+def test_bilinearity_on_device():
+    g1, g2 = C.g1_generator(), C.g2_generator()
+    a, b = rng.randrange(1, R), rng.randrange(1, R)
+    # e([a]P, [b]Q) == e([ab]P, Q)
+    pairs1 = [(g1.mul(a), g2.mul(b))]
+    pairs2 = [(g1.mul(a * b % R), g2)]
+    f1 = TP.pairing(*pack_pairs(pairs1))
+    f2 = TP.pairing(*pack_pairs(pairs2))
+    assert bool(np.asarray(T.fp12_eq(f1, f2))[0])
+
+
+def test_multi_pairing_product_is_one():
+    # e(P, Q) * e(-P, Q) == 1, plus an infinity pair contributing nothing
+    g1, g2 = C.g1_generator(), C.g2_generator()
+    a = rng.randrange(1, R)
+    p = g1.mul(a)
+    q = g2.mul(rng.randrange(1, R))
+    inf1 = C.Point(p.x, p.y, True)
+    pairs = [(p, q), (-p, q), (inf1, q), (inf1, q)]
+    assert bool(np.asarray(TP.multi_pairing_is_one(*pack_pairs(pairs))))
+
+    bad = [(p, q), (p, q), (inf1, q), (inf1, q)]
+    assert not bool(np.asarray(TP.multi_pairing_is_one(*pack_pairs(bad))))
+
+
+def test_multi_pairing_matches_oracle():
+    g1, g2 = C.g1_generator(), C.g2_generator()
+    pairs = [
+        (g1.mul(rng.randrange(1, R)), g2.mul(rng.randrange(1, R)))
+        for _ in range(3)
+    ]
+    got = TP.multi_pairing(*pack_pairs(pairs))
+    want = PR.multi_pairing(pairs).pow(3)
+    assert T.fp12_to_ref(got) == want

@@ -1,0 +1,4 @@
+"""Core chain runtime (reference beacon_node/beacon_chain, SURVEY.md
+section 2.3): BeaconChain orchestration, head tracking, import pipeline."""
+
+from .beacon_chain import BeaconChain, BlockError  # noqa: F401

@@ -1,0 +1,217 @@
+"""BeaconChain: the core chain runtime (reference
+beacon_node/beacon_chain/src/beacon_chain.rs -- process_block:2520,
+canonical head recompute, per-slot tasks). Wires store, fork choice, state
+transition, and the TPU signature backend behind one object.
+
+Block verification follows the reference's typestate pipeline
+(block_verification.rs:588-619): gossip checks -> batched signature
+verification (BlockSignatureVerifier, ONE backend call) -> state
+transition -> fork-choice import -> head update.
+"""
+
+from __future__ import annotations
+
+from ..crypto.bls import verify_signature_sets
+from ..fork_choice import ForkChoice
+from ..state_transition import (
+    BlockProcessingError,
+    BlockSignatureStrategy,
+    ConsensusContext,
+    clone_state,
+    per_block_processing,
+    process_slots,
+)
+from ..types import compute_epoch_at_slot, compute_start_slot_at_epoch
+from ..types.presets import Preset
+from ..store.hot_cold import HotColdDB
+from ..utils.slot_clock import ManualSlotClock
+
+
+class BlockError(ValueError):
+    pass
+
+
+class BeaconChain:
+    def __init__(
+        self,
+        store: HotColdDB,
+        genesis_state,
+        preset: Preset,
+        spec,
+        slot_clock=None,
+    ):
+        self.store = store
+        self.preset = preset
+        self.spec = spec
+        self.slot_clock = slot_clock or ManualSlotClock(
+            genesis_state.genesis_time, spec.seconds_per_slot
+        )
+
+        genesis_state_root = genesis_state.tree_hash_root()
+        # the canonical genesis block root: header with state_root filled,
+        # exactly as the first process_slot will reference it
+        from ..types.containers import BeaconBlockHeader
+
+        hdr = genesis_state.latest_block_header
+        genesis_root = BeaconBlockHeader(
+            slot=hdr.slot,
+            proposer_index=hdr.proposer_index,
+            parent_root=hdr.parent_root,
+            state_root=(
+                bytes(hdr.state_root)
+                if any(bytes(hdr.state_root))
+                else genesis_state_root
+            ),
+            body_root=hdr.body_root,
+        ).tree_hash_root()
+        self.genesis_block_root = genesis_root
+
+        # genesis checkpoints with zero roots alias the genesis block
+        def _ckpt(cp):
+            root = bytes(cp.root)
+            return (cp.epoch, root if any(root) else genesis_root)
+
+        jc = _ckpt(genesis_state.current_justified_checkpoint)
+        fc = _ckpt(genesis_state.finalized_checkpoint)
+
+        from ..fork_choice.fork_choice import _justified_balances
+
+        self.fork_choice = ForkChoice(
+            preset, spec, genesis_state.slot, genesis_root, jc, fc
+        )
+        self.fork_choice.justified_balances = _justified_balances(
+            genesis_state, preset
+        )
+
+        store.put_state(genesis_state_root, genesis_state)
+        store.put_chain_item(
+            b"block_post_state:" + genesis_root, genesis_state_root
+        )
+        self.head_root = genesis_root
+        self.head_state = clone_state(genesis_state)
+        self._states: dict[bytes, object] = {genesis_root: genesis_state}
+
+    # -- time ----------------------------------------------------------------
+
+    @property
+    def current_slot(self) -> int:
+        return self.slot_clock.current_slot()
+
+    def on_tick(self) -> None:
+        self.fork_choice.on_tick(self.current_slot)
+
+    # -- block import (beacon_chain.rs:2520 process_block) ------------------
+
+    def state_for_block_production(self, slot: int):
+        state = clone_state(self.head_state)
+        return process_slots(state, slot, self.preset, self.spec)
+
+    def process_block(
+        self,
+        signed_block,
+        strategy: BlockSignatureStrategy = BlockSignatureStrategy.VERIFY_BULK,
+    ) -> bytes:
+        """Full import: signature batch -> transition -> store -> fork
+        choice -> head update. Returns the block root."""
+        self.on_tick()
+        block = signed_block.message
+        block_root = block.tree_hash_root()
+        if block_root in self._states:
+            return block_root  # duplicate import
+
+        parent_root = bytes(block.parent_root)
+        parent_state = self._states.get(parent_root)
+        if parent_state is None:
+            raise BlockError(f"unknown parent {parent_root.hex()[:12]}")
+
+        state = clone_state(parent_state)
+        state = process_slots(state, block.slot, self.preset, self.spec)
+        ctxt = ConsensusContext(self.preset, self.spec)
+        try:
+            per_block_processing(
+                state,
+                signed_block,
+                self.preset,
+                self.spec,
+                strategy=strategy,
+                ctxt=ctxt,
+            )
+        except BlockProcessingError as e:
+            raise BlockError(str(e)) from None
+        state_root = state.tree_hash_root()
+        if bytes(block.state_root) != state_root:
+            raise BlockError("block state_root mismatch")
+
+        self.store.put_block(block_root, signed_block)
+        self.store.put_state(state_root, state)
+        self._states[block_root] = state
+
+        self.fork_choice.on_block(signed_block, block_root, state)
+        # fork-choice also counts the block's attestations
+        for att in block.body.attestations:
+            indexed = ctxt.get_indexed_attestation(state, att)
+            self.fork_choice.on_attestation(
+                att.data.slot,
+                list(indexed.attesting_indices),
+                bytes(att.data.beacon_block_root),
+            )
+        self.recompute_head()
+        self._prune_on_finality()
+        return block_root
+
+    # -- attestations (gossip path) -----------------------------------------
+
+    def apply_attestation(self, attestation, indexed_indices) -> None:
+        """Feed a verified unaggregated/aggregate attestation into fork
+        choice (verification lives in the processor/verification layer)."""
+        self.fork_choice.on_attestation(
+            attestation.data.slot,
+            indexed_indices,
+            bytes(attestation.data.beacon_block_root),
+        )
+
+    # -- head (canonical_head.rs recompute_head) ----------------------------
+
+    def recompute_head(self) -> bytes:
+        head = self.fork_choice.get_head()
+        if head != self.head_root:
+            self.head_root = head
+            self.head_state = self._states[head]
+        return head
+
+    def head(self):
+        return self.head_root, self.head_state
+
+    @property
+    def finalized_checkpoint(self):
+        return self.fork_choice.finalized_checkpoint
+
+    @property
+    def justified_checkpoint(self):
+        return self.fork_choice.justified_checkpoint
+
+    # -- finality housekeeping ----------------------------------------------
+
+    def _prune_on_finality(self) -> None:
+        fin_epoch, fin_root = self.fork_choice.finalized_checkpoint
+        if fin_epoch == 0 or fin_root not in self._states:
+            return
+        fin_slot = compute_start_slot_at_epoch(fin_epoch, self.preset)
+        # canonical chain: walk head ancestry
+        canonical = set()
+        root = self.head_root
+        while root in self._states:
+            canonical.add(root)
+            blk = self.store.get_block(root)
+            if blk is None:
+                break
+            root = bytes(blk.message.parent_root)
+        # drop in-memory states for pruned forks below finality
+        for root in list(self._states.keys()):
+            blk = self.store.get_block(root)
+            if blk is None:
+                continue
+            if blk.message.slot < fin_slot and root != fin_root:
+                del self._states[root]
+        self.store.migrate_to_freezer(fin_slot, canonical)
+        self.fork_choice.proto.proto_array.maybe_prune(fin_root)

@@ -1,0 +1,139 @@
+"""Spec fork choice over the proto array (reference consensus/fork_choice/
+src/fork_choice.rs: on_block:747, on_attestation:1162, get_head:527).
+
+Keeps the store checkpoints, queues current-slot attestations until the
+next slot (spec: attestations can only influence fork choice from the
+following slot), and applies proposer boost.
+"""
+
+from __future__ import annotations
+
+from ..types import compute_epoch_at_slot, compute_start_slot_at_epoch
+from ..types.helpers import is_active_validator
+from ..types.presets import Preset
+from .proto_array import ProtoArrayForkChoice, ProtoArrayError
+
+
+class ForkChoiceError(ValueError):
+    pass
+
+
+def _justified_balances(state, preset) -> list[int]:
+    """Spec fork-choice weights: EFFECTIVE balances of validators active at
+    the state's epoch; everyone else weighs zero (exited/slashed stakes
+    must not keep moving the head)."""
+    epoch = compute_epoch_at_slot(state.slot, preset)
+    return [
+        v.effective_balance if is_active_validator(v, epoch) else 0
+        for v in state.validators
+    ]
+
+
+class ForkChoice:
+    def __init__(
+        self,
+        preset: Preset,
+        spec,
+        genesis_slot: int,
+        genesis_root: bytes,
+        justified_checkpoint: tuple[int, bytes],
+        finalized_checkpoint: tuple[int, bytes],
+    ):
+        self.preset = preset
+        self.spec = spec
+        self.justified_checkpoint = justified_checkpoint
+        self.finalized_checkpoint = finalized_checkpoint
+        self.justified_balances: list[int] = []
+        self.current_slot = genesis_slot
+        self.queued_attestations: list[tuple[int, int, bytes, int]] = []
+        self.proto = ProtoArrayForkChoice(
+            genesis_slot,
+            genesis_root,
+            justified_checkpoint,
+            finalized_checkpoint,
+        )
+
+    # -- time (fork_choice.rs on_tick) --------------------------------------
+
+    def on_tick(self, slot: int) -> None:
+        while self.current_slot < slot:
+            self.current_slot += 1
+            self._dequeue_attestations()
+            # proposer boost expires at the start of the next slot
+            self.proto.proposer_boost_root = None
+
+    def _dequeue_attestations(self) -> None:
+        remaining = []
+        for att_slot, validator, root, epoch in self.queued_attestations:
+            if att_slot + 1 <= self.current_slot:
+                self.proto.process_attestation(validator, root, epoch)
+            else:
+                remaining.append((att_slot, validator, root, epoch))
+        self.queued_attestations = remaining
+
+    # -- blocks (fork_choice.rs:747 on_block) -------------------------------
+
+    def on_block(self, signed_block, block_root: bytes, state) -> None:
+        """`state` is the post-state of the block: its justified/finalized
+        checkpoints feed the store (the reference's unrealized-justification
+        machinery reduces to this under per-block epoch processing)."""
+        block = signed_block.message
+        if block.slot > self.current_slot:
+            raise ForkChoiceError("block from the future")
+        jc = (
+            state.current_justified_checkpoint.epoch,
+            bytes(state.current_justified_checkpoint.root),
+        )
+        fc = (
+            state.finalized_checkpoint.epoch,
+            bytes(state.finalized_checkpoint.root),
+        )
+        if jc[0] > self.justified_checkpoint[0]:
+            self.justified_checkpoint = jc
+            self.justified_balances = _justified_balances(state, self.preset)
+        if fc[0] > self.finalized_checkpoint[0]:
+            self.finalized_checkpoint = fc
+        self.proto.process_block(
+            block.slot, block_root, bytes(block.parent_root), jc, fc
+        )
+        # proposer boost: only the FIRST timely block of the slot gets it
+        # (spec: set only when proposer_boost_root is empty)
+        if (
+            block.slot == self.current_slot
+            and self.proto.proposer_boost_root is None
+        ):
+            self.proto.proposer_boost_root = block_root
+        if not self.justified_balances:
+            self.justified_balances = _justified_balances(state, self.preset)
+
+    # -- attestations (fork_choice.rs:1162 on_attestation) ------------------
+
+    def on_attestation(
+        self, attestation_slot: int, attesting_indices, block_root: bytes
+    ) -> None:
+        epoch = compute_epoch_at_slot(attestation_slot, self.preset)
+        for v in attesting_indices:
+            if attestation_slot + 1 <= self.current_slot:
+                self.proto.process_attestation(v, bytes(block_root), epoch)
+            else:
+                self.queued_attestations.append(
+                    (attestation_slot, v, bytes(block_root), epoch)
+                )
+
+    # -- head (fork_choice.rs:527 get_head) ---------------------------------
+
+    def get_head(self) -> bytes:
+        boost = 0
+        if self.proto.proposer_boost_root is not None:
+            total = sum(self.justified_balances)
+            committee_weight = total // self.preset.slots_per_epoch
+            boost = committee_weight * self.spec.proposer_score_boost // 100
+        try:
+            return self.proto.find_head(
+                self.justified_checkpoint,
+                self.finalized_checkpoint,
+                self.justified_balances,
+                boost,
+            )
+        except ProtoArrayError as e:
+            raise ForkChoiceError(str(e)) from None

@@ -1,0 +1,332 @@
+"""Proto-array LMD-GHOST fork choice (reference consensus/proto_array:
+flat node vector, O(n) score application and head finding,
+proto_array.rs:70,167,644 and proto_array_fork_choice.rs:294).
+
+The structure is a parent-pointer forest stored as an append-only list in
+insertion order (children after parents), so score propagation is one
+reverse sweep and best-descendant maintenance is O(1) per visited node.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+
+
+class ProtoArrayError(ValueError):
+    pass
+
+
+@dataclass
+class ProtoNode:
+    slot: int
+    root: bytes
+    parent: int | None
+    justified_checkpoint: tuple[int, bytes]
+    finalized_checkpoint: tuple[int, bytes]
+    weight: int = 0
+    best_child: int | None = None
+    best_descendant: int | None = None
+
+
+@dataclass
+class VoteTracker:
+    """Latest message per validator (proto_array_fork_choice.rs VoteTracker)."""
+
+    current_root: bytes = b""
+    next_root: bytes = b""
+    next_epoch: int = 0
+
+
+class ProtoArray:
+    def __init__(
+        self,
+        justified_checkpoint: tuple[int, bytes],
+        finalized_checkpoint: tuple[int, bytes],
+    ):
+        self.nodes: list[ProtoNode] = []
+        self.indices: dict[bytes, int] = {}
+        self.justified_checkpoint = justified_checkpoint
+        self.finalized_checkpoint = finalized_checkpoint
+        self.prune_threshold = 256
+
+    # -- insertion (proto_array.rs on_block) --------------------------------
+
+    def on_block(
+        self,
+        slot: int,
+        root: bytes,
+        parent_root: bytes | None,
+        justified_checkpoint: tuple[int, bytes],
+        finalized_checkpoint: tuple[int, bytes],
+    ) -> None:
+        if root in self.indices:
+            return
+        parent = self.indices.get(parent_root) if parent_root else None
+        node = ProtoNode(
+            slot=slot,
+            root=root,
+            parent=parent,
+            justified_checkpoint=justified_checkpoint,
+            finalized_checkpoint=finalized_checkpoint,
+        )
+        index = len(self.nodes)
+        self.nodes.append(node)
+        self.indices[root] = index
+        if parent is not None:
+            self._maybe_update_best_child_and_descendant(parent, index)
+
+    # -- score changes (proto_array.rs:167 apply_score_changes) -------------
+
+    def apply_score_changes(
+        self,
+        deltas: list[int],
+        justified_checkpoint: tuple[int, bytes],
+        finalized_checkpoint: tuple[int, bytes],
+        proposer_boost_root: bytes | None = None,
+        proposer_boost_amount: int = 0,
+    ) -> None:
+        if len(deltas) != len(self.nodes):
+            raise ProtoArrayError("deltas length != nodes length")
+        self.justified_checkpoint = justified_checkpoint
+        self.finalized_checkpoint = finalized_checkpoint
+
+        # proposer boost enters as an extra (transient) delta each run:
+        # previous boost is subtracted by the caller via deltas
+        if proposer_boost_root is not None:
+            idx = self.indices.get(proposer_boost_root)
+            if idx is not None:
+                deltas[idx] += proposer_boost_amount
+
+        for i in range(len(self.nodes) - 1, -1, -1):
+            node = self.nodes[i]
+            delta = deltas[i]
+            if delta:
+                node.weight += delta
+                if node.weight < 0:
+                    raise ProtoArrayError("negative node weight")
+                if node.parent is not None:
+                    deltas[node.parent] += delta
+        for i in range(len(self.nodes) - 1, -1, -1):
+            node = self.nodes[i]
+            if node.parent is not None:
+                self._maybe_update_best_child_and_descendant(node.parent, i)
+
+    # -- head (proto_array.rs:644 find_head) --------------------------------
+
+    def find_head(self, justified_root: bytes) -> bytes:
+        idx = self.indices.get(justified_root)
+        if idx is None:
+            raise ProtoArrayError("justified root unknown to proto array")
+        node = self.nodes[idx]
+        best = (
+            self.nodes[node.best_descendant]
+            if node.best_descendant is not None
+            else node
+        )
+        if not self._node_is_viable_for_head(best):
+            raise ProtoArrayError(
+                "best node is not viable for head (justified/finalized mismatch)"
+            )
+        return best.root
+
+    # -- maintenance ---------------------------------------------------------
+
+    def _maybe_update_best_child_and_descendant(
+        self, parent_index: int, child_index: int
+    ) -> None:
+        child = self.nodes[child_index]
+        parent = self.nodes[parent_index]
+        child_leads = (
+            child.best_descendant
+            if child.best_descendant is not None
+            else child_index
+        )
+        child_viable = self._node_is_viable_for_head(self.nodes[child_leads])
+
+        def make_best():
+            parent.best_child = child_index
+            parent.best_descendant = child_leads
+
+        if parent.best_child is None:
+            if child_viable:
+                make_best()
+            return
+        if parent.best_child == child_index:
+            if not child_viable:
+                parent.best_child = None
+                parent.best_descendant = None
+                # try to find another viable child
+                for i, n in enumerate(self.nodes):
+                    if n.parent == parent_index and i != child_index:
+                        self._maybe_update_best_child_and_descendant(
+                            parent_index, i
+                        )
+            else:
+                make_best()
+            return
+        best = self.nodes[parent.best_child]
+        best_leads = (
+            best.best_descendant
+            if best.best_descendant is not None
+            else parent.best_child
+        )
+        best_lead_node = self.nodes[best_leads]
+        best_viable = self._node_is_viable_for_head(best_lead_node)
+        if child_viable and not best_viable:
+            make_best()
+            return
+        if not child_viable:
+            return
+        # node.weight is the SUBTREE weight (score sweeps propagate child
+        # weights into parents), so direct children compare directly
+        if child.weight > best.weight or (
+            child.weight == best.weight and child.root > best.root
+        ):
+            make_best()
+
+    def _node_is_viable_for_head(self, node: ProtoNode) -> bool:
+        """proto_array.rs node_is_viable_for_head: the node must agree with
+        the store's justified and finalized checkpoints (epoch 0 wildcards
+        accepted, matching genesis bootstrapping)."""
+        j_ok = (
+            node.justified_checkpoint == self.justified_checkpoint
+            or self.justified_checkpoint[0] == 0
+        )
+        f_ok = (
+            node.finalized_checkpoint == self.finalized_checkpoint
+            or self.finalized_checkpoint[0] == 0
+        )
+        return j_ok and f_ok
+
+    # -- pruning (proto_array.rs maybe_prune) --------------------------------
+
+    def maybe_prune(self, finalized_root: bytes) -> None:
+        idx = self.indices.get(finalized_root)
+        if idx is None:
+            raise ProtoArrayError("finalized root unknown")
+        if idx < self.prune_threshold:
+            return
+        keep = self.nodes[idx:]
+        self.indices = {}
+        remap = {}
+        for new_i, node in enumerate(keep):
+            remap[idx + new_i] = new_i
+        for new_i, node in enumerate(keep):
+            node.parent = (
+                remap.get(node.parent) if node.parent is not None else None
+            )
+            node.best_child = (
+                remap.get(node.best_child)
+                if node.best_child is not None
+                else None
+            )
+            node.best_descendant = (
+                remap.get(node.best_descendant)
+                if node.best_descendant is not None
+                else None
+            )
+            self.indices[node.root] = new_i
+        self.nodes = keep
+
+
+class ProtoArrayForkChoice:
+    """Vote bookkeeping + deltas over the proto array
+    (proto_array_fork_choice.rs:294)."""
+
+    def __init__(
+        self,
+        finalized_slot: int,
+        finalized_root: bytes,
+        justified_checkpoint: tuple[int, bytes],
+        finalized_checkpoint: tuple[int, bytes],
+    ):
+        self.proto_array = ProtoArray(
+            justified_checkpoint, finalized_checkpoint
+        )
+        self.votes: dict[int, VoteTracker] = {}
+        self.balances: list[int] = []
+        self.proposer_boost_root: bytes | None = None
+        self._previous_boost: tuple[bytes, int] | None = None
+        self.proto_array.on_block(
+            finalized_slot,
+            finalized_root,
+            None,
+            justified_checkpoint,
+            finalized_checkpoint,
+        )
+
+    def process_block(
+        self, slot, root, parent_root, justified_checkpoint, finalized_checkpoint
+    ):
+        self.proto_array.on_block(
+            slot, root, parent_root, justified_checkpoint, finalized_checkpoint
+        )
+
+    def process_attestation(
+        self, validator_index: int, block_root: bytes, target_epoch: int
+    ):
+        vote = self.votes.setdefault(validator_index, VoteTracker())
+        # a fresh tracker accepts any vote (incl. target epoch 0 in the
+        # chain's first epoch -- the reference's `vote == default` escape)
+        is_fresh = not vote.next_root and not vote.current_root
+        if is_fresh or target_epoch > vote.next_epoch:
+            vote.next_root = block_root
+            vote.next_epoch = target_epoch
+
+    def find_head(
+        self,
+        justified_checkpoint: tuple[int, bytes],
+        finalized_checkpoint: tuple[int, bytes],
+        justified_state_balances: list[int],
+        proposer_boost_amount: int = 0,
+    ) -> bytes:
+        new_balances = justified_state_balances
+        deltas = self._compute_deltas(new_balances)
+
+        # proposer boost: subtract previous boost, add current
+        if self._previous_boost is not None:
+            root, amount = self._previous_boost
+            idx = self.proto_array.indices.get(root)
+            if idx is not None:
+                deltas[idx] -= amount
+            self._previous_boost = None
+        boost_root = None
+        if self.proposer_boost_root is not None and proposer_boost_amount:
+            boost_root = self.proposer_boost_root
+            self._previous_boost = (boost_root, proposer_boost_amount)
+
+        self.proto_array.apply_score_changes(
+            deltas,
+            justified_checkpoint,
+            finalized_checkpoint,
+            boost_root,
+            proposer_boost_amount,
+        )
+        self.balances = list(new_balances)
+        return self.proto_array.find_head(justified_checkpoint[1])
+
+    def _compute_deltas(self, new_balances: list[int]) -> list[int]:
+        """proto_array_fork_choice.rs compute_deltas: one delta per node
+        from changed validator votes and balance changes."""
+        deltas = [0] * len(self.proto_array.nodes)
+        for validator, vote in self.votes.items():
+            old_balance = (
+                self.balances[validator]
+                if validator < len(self.balances)
+                else 0
+            )
+            new_balance = (
+                new_balances[validator]
+                if validator < len(new_balances)
+                else 0
+            )
+            if vote.current_root == vote.next_root and old_balance == new_balance:
+                continue
+            idx = self.proto_array.indices.get(vote.current_root)
+            if idx is not None:
+                deltas[idx] -= old_balance
+            idx = self.proto_array.indices.get(vote.next_root)
+            if idx is not None:
+                deltas[idx] += new_balance
+            vote.current_root = vote.next_root
+        return deltas

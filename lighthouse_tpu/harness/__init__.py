@@ -1,0 +1,5 @@
+"""In-process test harnesses (reference beacon_chain/src/test_utils.rs +
+testing/: BeaconChainHarness, EphemeralHarnessType, manual clocks)."""
+
+from .beacon_chain_harness import BeaconChainHarness  # noqa: F401
+from .chain import StateHarness  # noqa: F401

@@ -1,0 +1,178 @@
+"""Hot/cold database (reference beacon_node/store/src/hot_cold_store.rs:48):
+hot side stores recent blocks + periodic full states with per-block
+summaries; the freezer keeps finalized history as restore points. States
+between snapshots/restore points are rebuilt by block replay
+(reference reconstruct.rs / BlockReplayer).
+"""
+
+from __future__ import annotations
+
+import struct
+
+from ..state_transition import BlockReplayer, clone_state, process_slots
+from ..types import compute_epoch_at_slot, state_class_for, types_for
+from ..types.presets import Preset
+from .kv import Column, KeyValueStore, slot_key
+
+
+class StoreError(KeyError):
+    pass
+
+
+class HotColdDB:
+    def __init__(
+        self,
+        kv: KeyValueStore,
+        preset: Preset,
+        spec,
+        slots_per_snapshot: int | None = None,
+    ):
+        self.kv = kv
+        self.preset = preset
+        self.spec = spec
+        # hot snapshot cadence: every epoch by default
+        self.slots_per_snapshot = slots_per_snapshot or preset.slots_per_epoch
+        self.split_slot = 0  # hot/cold boundary (advances on finality)
+
+    # -- blocks --------------------------------------------------------------
+
+    def put_block(self, block_root: bytes, signed_block) -> None:
+        fork = type(signed_block).fork_name
+        payload = fork.encode() + b"\x00" + signed_block.as_ssz_bytes()
+        self.kv.put(Column.BLOCK, block_root, payload)
+
+    def get_block(self, block_root: bytes):
+        data = self.kv.get(Column.BLOCK, block_root)
+        if data is None:
+            return None
+        fork, _, body = data.partition(b"\x00")
+        t = types_for(self.preset)
+        from ..types import block_classes_for
+
+        _, signed_cls, _ = block_classes_for(t, fork.decode())
+        return signed_cls.from_ssz_bytes(body)
+
+    # -- states --------------------------------------------------------------
+
+    def put_state(self, state_root: bytes, state) -> None:
+        """Full state at snapshot cadence; otherwise a summary pointing to
+        the previous snapshot (hot_cold_store.rs stores per-slot summaries
+        + periodic full states the same way)."""
+        if state.slot % self.slots_per_snapshot == 0:
+            payload = (
+                b"F" + state.fork_name.encode() + b"\x00" + state.as_ssz_bytes()
+            )
+            self.kv.put(Column.STATE, state_root, payload)
+        else:
+            # block root = header root with state_root filled (the header in
+            # a post-block state still has it zeroed; the block's state_root
+            # IS this state's root)
+            from ..types.containers import BeaconBlockHeader
+
+            hdr = state.latest_block_header
+            block_root = BeaconBlockHeader(
+                slot=hdr.slot,
+                proposer_index=hdr.proposer_index,
+                parent_root=hdr.parent_root,
+                state_root=(
+                    bytes(hdr.state_root)
+                    if any(bytes(hdr.state_root))
+                    else state_root
+                ),
+                body_root=hdr.body_root,
+            ).tree_hash_root()
+            summary = struct.pack(">Q", state.slot) + block_root
+            self.kv.put(Column.STATE_SUMMARY, state_root, summary)
+        self.kv.put(
+            Column.CHAIN, b"state_at_slot:" + slot_key(state.slot), state_root
+        )
+
+    def get_full_state(self, state_root: bytes):
+        data = self.kv.get(Column.STATE, state_root)
+        if data is None:
+            return None
+        fork, _, body = data[1:].partition(b"\x00")
+        t = types_for(self.preset)
+        cls = state_class_for(t, fork.decode())
+        return cls.from_ssz_bytes(body)
+
+    def get_state(self, state_root: bytes, blocks_by_root=None):
+        """Load a state, replaying blocks from the nearest stored snapshot
+        when only a summary exists. `blocks_by_root(root)` resolves blocks
+        (defaults to this store)."""
+        full = self.get_full_state(state_root)
+        if full is not None:
+            return full
+        summary = self.kv.get(Column.STATE_SUMMARY, state_root)
+        if summary is None:
+            raise StoreError(f"unknown state {state_root.hex()[:12]}")
+        (slot,) = struct.unpack(">Q", summary[:8])
+        block_root = summary[8:]
+        get_block = blocks_by_root or self.get_block
+
+        # walk back through blocks until one whose POST-state is stored full
+        chain = []
+        root = block_root
+        base_state = None
+        while True:
+            block = get_block(root)
+            if block is None:
+                # the genesis "block" is a header, not a stored block: its
+                # post-state mapping is recorded at chain init
+                mapped = self.get_chain_item(b"block_post_state:" + root)
+                if mapped is not None:
+                    base_state = self.get_full_state(mapped)
+                    if base_state is not None:
+                        break
+                raise StoreError(f"missing block {root.hex()[:12]} for replay")
+            post_state_root = bytes(block.message.state_root)
+            base_state = self.get_full_state(post_state_root)
+            if base_state is not None:
+                break  # replay starts AFTER this block
+            chain.append(block)
+            root = bytes(block.message.parent_root)
+
+        chain.reverse()
+        replayer = BlockReplayer(base_state, self.preset, self.spec)
+        replayer.apply_blocks(chain, target_slot=slot)
+        return replayer.state
+
+    # -- chain metadata ------------------------------------------------------
+
+    def put_chain_item(self, key: bytes, value: bytes) -> None:
+        self.kv.put(Column.CHAIN, key, value)
+
+    def get_chain_item(self, key: bytes) -> bytes | None:
+        return self.kv.get(Column.CHAIN, key)
+
+    # -- freezer migration (hot_cold_store.rs:48-53 + migrate.rs) -----------
+
+    def migrate_to_freezer(self, finalized_slot: int, canonical_roots) -> None:
+        """Move finalized blocks to the freezer column and advance the
+        split point; prune non-canonical hot entries older than the split.
+        `canonical_roots`: {block_root} on the finalized chain."""
+        for root in list(self.kv.keys(Column.BLOCK)):
+            data = self.kv.get(Column.BLOCK, root)
+            if data is None:
+                continue
+            block = self.get_block(root)
+            if block.message.slot < finalized_slot:
+                if root in canonical_roots:
+                    self.kv.put(Column.FREEZER_BLOCK, root, data)
+                self.kv.delete(Column.BLOCK, root)
+        self.split_slot = finalized_slot
+        self.put_chain_item(b"split_slot", struct.pack(">Q", finalized_slot))
+
+    def get_block_any_temperature(self, block_root: bytes):
+        blk = self.get_block(block_root)
+        if blk is not None:
+            return blk
+        data = self.kv.get(Column.FREEZER_BLOCK, block_root)
+        if data is None:
+            return None
+        fork, _, body = data.partition(b"\x00")
+        t = types_for(self.preset)
+        from ..types import block_classes_for
+
+        _, signed_cls, _ = block_classes_for(t, fork.decode())
+        return signed_cls.from_ssz_bytes(body)

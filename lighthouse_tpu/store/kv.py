@@ -1,0 +1,108 @@
+"""Key-value store abstraction (reference beacon_node/store/src/lib.rs:49,107
+KeyValueStore/ItemStore traits; memory_store.rs; leveldb_store.rs).
+
+Backends: `MemoryStore` (tests/ephemeral chains) and `FileStore` (simple
+column-file persistence). A C++ embedded-store backend slots in behind the
+same interface (the reference's LevelDB seat) in a later round.
+"""
+
+from __future__ import annotations
+
+import os
+import struct
+from collections import OrderedDict
+
+
+class Column:
+    BLOCK = b"blk"
+    STATE = b"ste"
+    STATE_SUMMARY = b"ssu"
+    CHAIN = b"chn"
+    FREEZER_BLOCK = b"fbk"
+    FREEZER_STATE = b"fst"
+
+
+class KeyValueStore:
+    def get(self, column: bytes, key: bytes) -> bytes | None:
+        raise NotImplementedError
+
+    def put(self, column: bytes, key: bytes, value: bytes) -> None:
+        raise NotImplementedError
+
+    def delete(self, column: bytes, key: bytes) -> None:
+        raise NotImplementedError
+
+    def keys(self, column: bytes):
+        raise NotImplementedError
+
+    def do_atomically(self, ops) -> None:
+        """ops: [(op, column, key, value-or-None)] with op in {put, delete}."""
+        for op, column, key, value in ops:
+            if op == "put":
+                self.put(column, key, value)
+            else:
+                self.delete(column, key)
+
+
+class MemoryStore(KeyValueStore):
+    def __init__(self):
+        self._data: dict[bytes, OrderedDict[bytes, bytes]] = {}
+
+    def _col(self, column: bytes) -> OrderedDict:
+        return self._data.setdefault(column, OrderedDict())
+
+    def get(self, column, key):
+        return self._col(column).get(key)
+
+    def put(self, column, key, value):
+        self._col(column)[key] = bytes(value)
+
+    def delete(self, column, key):
+        self._col(column).pop(key, None)
+
+    def keys(self, column):
+        return list(self._col(column).keys())
+
+
+class FileStore(KeyValueStore):
+    """One file per entry under <root>/<column>/<hexkey>. Crash-safe enough
+    for node-restart resume; not a performance path."""
+
+    def __init__(self, root: str):
+        self.root = root
+        os.makedirs(root, exist_ok=True)
+
+    def _path(self, column: bytes, key: bytes) -> str:
+        d = os.path.join(self.root, column.decode())
+        os.makedirs(d, exist_ok=True)
+        return os.path.join(d, key.hex())
+
+    def get(self, column, key):
+        try:
+            with open(self._path(column, key), "rb") as f:
+                return f.read()
+        except FileNotFoundError:
+            return None
+
+    def put(self, column, key, value):
+        path = self._path(column, key)
+        tmp = path + ".tmp"
+        with open(tmp, "wb") as f:
+            f.write(value)
+        os.replace(tmp, path)
+
+    def delete(self, column, key):
+        try:
+            os.remove(self._path(column, key))
+        except FileNotFoundError:
+            pass
+
+    def keys(self, column):
+        d = os.path.join(self.root, column.decode())
+        if not os.path.isdir(d):
+            return []
+        return [bytes.fromhex(f) for f in os.listdir(d) if not f.endswith(".tmp")]
+
+
+def slot_key(slot: int) -> bytes:
+    return struct.pack(">Q", slot)

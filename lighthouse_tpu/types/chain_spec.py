@@ -1,0 +1,150 @@
+"""Runtime chain parameters (the reference's ChainSpec,
+consensus/types/src/chain_spec.rs): fork schedule, domains, gwei amounts,
+timing and penalty constants -- everything that can vary per network
+without changing SSZ shapes."""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+
+FAR_FUTURE_EPOCH = 2**64 - 1
+GENESIS_SLOT = 0
+GENESIS_EPOCH = 0
+
+# BLS signature domains (spec constants)
+DOMAIN_BEACON_PROPOSER = (0).to_bytes(4, "little")
+DOMAIN_BEACON_ATTESTER = (1).to_bytes(4, "little")
+DOMAIN_RANDAO = (2).to_bytes(4, "little")
+DOMAIN_DEPOSIT = (3).to_bytes(4, "little")
+DOMAIN_VOLUNTARY_EXIT = (4).to_bytes(4, "little")
+DOMAIN_SELECTION_PROOF = (5).to_bytes(4, "little")
+DOMAIN_AGGREGATE_AND_PROOF = (6).to_bytes(4, "little")
+DOMAIN_SYNC_COMMITTEE = (7).to_bytes(4, "little")
+DOMAIN_SYNC_COMMITTEE_SELECTION_PROOF = (8).to_bytes(4, "little")
+DOMAIN_CONTRIBUTION_AND_PROOF = (9).to_bytes(4, "little")
+
+
+@dataclass
+class ChainSpec:
+    config_name: str = "mainnet"
+
+    # forks
+    genesis_fork_version: bytes = b"\x00\x00\x00\x00"
+    altair_fork_version: bytes = b"\x01\x00\x00\x00"
+    altair_fork_epoch: int | None = 74240
+    bellatrix_fork_version: bytes = b"\x02\x00\x00\x00"
+    bellatrix_fork_epoch: int | None = 144896
+
+    # time
+    seconds_per_slot: int = 12
+    min_genesis_time: int = 1606824000
+    genesis_delay: int = 604800
+    min_genesis_active_validator_count: int = 16384
+
+    # gwei
+    max_effective_balance: int = 32 * 10**9
+    ejection_balance: int = 16 * 10**9
+    effective_balance_increment: int = 10**9
+    min_deposit_amount: int = 10**9
+
+    # validator lifecycle
+    min_attestation_inclusion_delay: int = 1
+    min_seed_lookahead: int = 1
+    max_seed_lookahead: int = 4
+    min_validator_withdrawability_delay: int = 256
+    shard_committee_period: int = 256
+    min_epochs_to_inactivity_penalty: int = 4
+    churn_limit_quotient: int = 65536
+    min_per_epoch_churn_limit: int = 4
+
+    # rewards & penalties (phase0)
+    base_reward_factor: int = 64
+    whistleblower_reward_quotient: int = 512
+    proposer_reward_quotient: int = 8
+    inactivity_penalty_quotient: int = 2**26
+    min_slashing_penalty_quotient: int = 128
+    proportional_slashing_multiplier: int = 1
+
+    # rewards & penalties (altair overrides)
+    inactivity_penalty_quotient_altair: int = 3 * 2**24
+    min_slashing_penalty_quotient_altair: int = 64
+    proportional_slashing_multiplier_altair: int = 2
+    inactivity_score_bias: int = 4
+    inactivity_score_recovery_rate: int = 16
+
+    # attestation aggregation
+    target_aggregators_per_committee: int = 16
+    attestation_subnet_count: int = 64
+
+    # deposit contract
+    deposit_chain_id: int = 1
+    deposit_network_id: int = 1
+    deposit_contract_address: bytes = bytes(20)
+
+    # misc
+    hysteresis_quotient: int = 4
+    hysteresis_downward_multiplier: int = 1
+    hysteresis_upward_multiplier: int = 5
+    proposer_score_boost: int = 40
+    random_subnets_per_validator: int = 1
+    epochs_per_random_subnet_subscription: int = 256
+    sync_committee_branch_depth: int = 5
+
+    terminal_total_difficulty: int = 2**256 - 2**10
+    terminal_block_hash: bytes = bytes(32)
+    terminal_block_hash_activation_epoch: int = FAR_FUTURE_EPOCH
+    safe_slots_to_import_optimistically: int = 128
+
+    def fork_version_at_epoch(self, epoch: int) -> bytes:
+        if (
+            self.bellatrix_fork_epoch is not None
+            and epoch >= self.bellatrix_fork_epoch
+        ):
+            return self.bellatrix_fork_version
+        if self.altair_fork_epoch is not None and epoch >= self.altair_fork_epoch:
+            return self.altair_fork_version
+        return self.genesis_fork_version
+
+    def fork_name_at_epoch(self, epoch: int) -> str:
+        if (
+            self.bellatrix_fork_epoch is not None
+            and epoch >= self.bellatrix_fork_epoch
+        ):
+            return "bellatrix"
+        if self.altair_fork_epoch is not None and epoch >= self.altair_fork_epoch:
+            return "altair"
+        return "phase0"
+
+    @classmethod
+    def mainnet(cls) -> "ChainSpec":
+        return cls()
+
+    @classmethod
+    def minimal(cls) -> "ChainSpec":
+        return cls(
+            config_name="minimal",
+            genesis_fork_version=b"\x00\x00\x00\x01",
+            altair_fork_version=b"\x01\x00\x00\x01",
+            altair_fork_epoch=None,
+            bellatrix_fork_version=b"\x02\x00\x00\x01",
+            bellatrix_fork_epoch=None,
+            seconds_per_slot=6,
+            min_genesis_active_validator_count=64,
+            churn_limit_quotient=32,
+            shard_committee_period=64,
+            min_validator_withdrawability_delay=256,
+        )
+
+    @classmethod
+    def interop(cls, altair_fork_epoch: int | None = None) -> "ChainSpec":
+        """Deterministic local-testing spec (the reference's interop
+        genesis path, lcli/environment interop support)."""
+        return cls(
+            config_name="interop",
+            genesis_fork_version=b"\x00\x00\x00\x20",
+            altair_fork_version=b"\x01\x00\x00\x20",
+            altair_fork_epoch=altair_fork_epoch,
+            bellatrix_fork_epoch=None,
+            seconds_per_slot=6,
+            min_genesis_active_validator_count=64,
+        )

@@ -1,0 +1,393 @@
+"""Consensus containers, phase0 + altair (reference consensus/types/src/*).
+
+Container classes are generated per compile-time preset by `types_for(preset)`
+(the Python equivalent of the reference's `EthSpec` type parameter,
+eth_spec.rs:365 -- list limits and vector lengths are baked into the SSZ
+descriptors). Multi-fork variants (the reference's superstruct enums,
+beacon_state.rs / beacon_block.rs) are separate classes sharing field names,
+plus `fork_name` class attributes for dispatch.
+
+NOTE: no `from __future__ import annotations` here -- the @container
+decorator consumes annotations as live SSZ descriptors, not strings.
+"""
+
+import functools
+from types import SimpleNamespace
+
+from ..ssz import (
+    Bitlist,
+    Bitvector,
+    Bytes4,
+    Bytes32,
+    Bytes48,
+    Bytes96,
+    List,
+    Vector,
+    boolean,
+    container,
+    uint8,
+    uint64,
+)
+from .presets import Preset
+
+DEPOSIT_CONTRACT_TREE_DEPTH = 32
+JUSTIFICATION_BITS_LENGTH = 4
+
+
+@container
+class Fork:
+    previous_version: Bytes4
+    current_version: Bytes4
+    epoch: uint64
+
+
+@container
+class ForkData:
+    current_version: Bytes4
+    genesis_validators_root: Bytes32
+
+
+@container
+class Checkpoint:
+    epoch: uint64
+    root: Bytes32
+
+
+@container
+class SigningData:
+    object_root: Bytes32
+    domain: Bytes32
+
+
+@container
+class Validator:
+    pubkey: Bytes48
+    withdrawal_credentials: Bytes32
+    effective_balance: uint64
+    slashed: boolean
+    activation_eligibility_epoch: uint64
+    activation_epoch: uint64
+    exit_epoch: uint64
+    withdrawable_epoch: uint64
+
+
+@container
+class AttestationData:
+    slot: uint64
+    index: uint64
+    beacon_block_root: Bytes32
+    source: Checkpoint.ssz_type
+    target: Checkpoint.ssz_type
+
+
+@container
+class Eth1Data:
+    deposit_root: Bytes32
+    deposit_count: uint64
+    block_hash: Bytes32
+
+
+@container
+class DepositMessage:
+    pubkey: Bytes48
+    withdrawal_credentials: Bytes32
+    amount: uint64
+
+
+@container
+class DepositData:
+    pubkey: Bytes48
+    withdrawal_credentials: Bytes32
+    amount: uint64
+    signature: Bytes96
+
+
+@container
+class Deposit:
+    proof: Vector(Bytes32, DEPOSIT_CONTRACT_TREE_DEPTH + 1)
+    data: DepositData.ssz_type
+
+
+@container
+class VoluntaryExit:
+    epoch: uint64
+    validator_index: uint64
+
+
+@container
+class SignedVoluntaryExit:
+    message: VoluntaryExit.ssz_type
+    signature: Bytes96
+
+
+@container
+class BeaconBlockHeader:
+    slot: uint64
+    proposer_index: uint64
+    parent_root: Bytes32
+    state_root: Bytes32
+    body_root: Bytes32
+
+
+@container
+class SignedBeaconBlockHeader:
+    message: BeaconBlockHeader.ssz_type
+    signature: Bytes96
+
+
+@container
+class ProposerSlashing:
+    signed_header_1: SignedBeaconBlockHeader.ssz_type
+    signed_header_2: SignedBeaconBlockHeader.ssz_type
+
+
+@container
+class SyncCommitteeMessage:
+    slot: uint64
+    beacon_block_root: Bytes32
+    validator_index: uint64
+    signature: Bytes96
+
+
+@functools.lru_cache(maxsize=None)
+def types_for(preset: Preset) -> SimpleNamespace:
+    """Generate the preset-sized containers (IndexedAttestation through
+    BeaconState). Cached: class identity is stable per preset."""
+
+    @container
+    class IndexedAttestation:
+        attesting_indices: List(uint64, preset.max_validators_per_committee)
+        data: AttestationData.ssz_type
+        signature: Bytes96
+
+    @container
+    class AttesterSlashing:
+        attestation_1: IndexedAttestation.ssz_type
+        attestation_2: IndexedAttestation.ssz_type
+
+    @container
+    class Attestation:
+        aggregation_bits: Bitlist(preset.max_validators_per_committee)
+        data: AttestationData.ssz_type
+        signature: Bytes96
+
+    @container
+    class PendingAttestation:
+        aggregation_bits: Bitlist(preset.max_validators_per_committee)
+        data: AttestationData.ssz_type
+        inclusion_delay: uint64
+        proposer_index: uint64
+
+    @container
+    class AggregateAndProof:
+        aggregator_index: uint64
+        aggregate: Attestation.ssz_type
+        selection_proof: Bytes96
+
+    @container
+    class SignedAggregateAndProof:
+        message: AggregateAndProof.ssz_type
+        signature: Bytes96
+
+    @container
+    class SyncAggregate:
+        sync_committee_bits: Bitvector(preset.sync_committee_size)
+        sync_committee_signature: Bytes96
+
+    @container
+    class SyncCommittee:
+        pubkeys: Vector(Bytes48, preset.sync_committee_size)
+        aggregate_pubkey: Bytes48
+
+    @container
+    class SyncCommitteeContribution:
+        slot: uint64
+        beacon_block_root: Bytes32
+        subcommittee_index: uint64
+        aggregation_bits: Bitvector(preset.sync_subcommittee_size)
+        signature: Bytes96
+
+    @container
+    class ContributionAndProof:
+        aggregator_index: uint64
+        contribution: SyncCommitteeContribution.ssz_type
+        selection_proof: Bytes96
+
+    @container
+    class SignedContributionAndProof:
+        message: ContributionAndProof.ssz_type
+        signature: Bytes96
+
+    @container
+    class HistoricalBatch:
+        block_roots: Vector(Bytes32, preset.slots_per_historical_root)
+        state_roots: Vector(Bytes32, preset.slots_per_historical_root)
+
+    @container
+    class BeaconBlockBody:
+        randao_reveal: Bytes96
+        eth1_data: Eth1Data.ssz_type
+        graffiti: Bytes32
+        proposer_slashings: List(
+            ProposerSlashing.ssz_type, preset.max_proposer_slashings
+        )
+        attester_slashings: List(
+            AttesterSlashing.ssz_type, preset.max_attester_slashings
+        )
+        attestations: List(Attestation.ssz_type, preset.max_attestations)
+        deposits: List(Deposit.ssz_type, preset.max_deposits)
+        voluntary_exits: List(
+            SignedVoluntaryExit.ssz_type, preset.max_voluntary_exits
+        )
+
+    BeaconBlockBody.fork_name = "phase0"
+
+    @container
+    class BeaconBlockBodyAltair:
+        randao_reveal: Bytes96
+        eth1_data: Eth1Data.ssz_type
+        graffiti: Bytes32
+        proposer_slashings: List(
+            ProposerSlashing.ssz_type, preset.max_proposer_slashings
+        )
+        attester_slashings: List(
+            AttesterSlashing.ssz_type, preset.max_attester_slashings
+        )
+        attestations: List(Attestation.ssz_type, preset.max_attestations)
+        deposits: List(Deposit.ssz_type, preset.max_deposits)
+        voluntary_exits: List(
+            SignedVoluntaryExit.ssz_type, preset.max_voluntary_exits
+        )
+        sync_aggregate: SyncAggregate.ssz_type
+
+    BeaconBlockBodyAltair.fork_name = "altair"
+
+    def _block_classes(body_cls, fork):
+        @container
+        class BeaconBlock:
+            slot: uint64
+            proposer_index: uint64
+            parent_root: Bytes32
+            state_root: Bytes32
+            body: body_cls.ssz_type
+
+        @container
+        class SignedBeaconBlock:
+            message: BeaconBlock.ssz_type
+            signature: Bytes96
+
+        BeaconBlock.fork_name = fork
+        SignedBeaconBlock.fork_name = fork
+        return BeaconBlock, SignedBeaconBlock
+
+    BeaconBlock, SignedBeaconBlock = _block_classes(BeaconBlockBody, "phase0")
+    BeaconBlockAltair, SignedBeaconBlockAltair = _block_classes(
+        BeaconBlockBodyAltair, "altair"
+    )
+
+    _state_common = dict(
+        genesis_time=uint64,
+        genesis_validators_root=Bytes32,
+        slot=uint64,
+        fork=Fork.ssz_type,
+        latest_block_header=BeaconBlockHeader.ssz_type,
+        block_roots=Vector(Bytes32, preset.slots_per_historical_root),
+        state_roots=Vector(Bytes32, preset.slots_per_historical_root),
+        historical_roots=List(Bytes32, preset.historical_roots_limit),
+        eth1_data=Eth1Data.ssz_type,
+        eth1_data_votes=List(
+            Eth1Data.ssz_type, preset.slots_per_eth1_voting_period
+        ),
+        eth1_deposit_index=uint64,
+        validators=List(Validator.ssz_type, preset.validator_registry_limit),
+        balances=List(uint64, preset.validator_registry_limit),
+        randao_mixes=Vector(Bytes32, preset.epochs_per_historical_vector),
+        slashings=Vector(uint64, preset.epochs_per_slashings_vector),
+    )
+
+    def _make_state(name, fork, extra_fields):
+        ns = {"__annotations__": {**_state_common, **extra_fields}}
+        cls = type(name, (), ns)
+        cls = container(cls)
+        cls.fork_name = fork
+        return cls
+
+    BeaconState = _make_state(
+        "BeaconState",
+        "phase0",
+        dict(
+            previous_epoch_attestations=List(
+                PendingAttestation.ssz_type,
+                preset.max_attestations * preset.slots_per_epoch,
+            ),
+            current_epoch_attestations=List(
+                PendingAttestation.ssz_type,
+                preset.max_attestations * preset.slots_per_epoch,
+            ),
+            justification_bits=Bitvector(JUSTIFICATION_BITS_LENGTH),
+            previous_justified_checkpoint=Checkpoint.ssz_type,
+            current_justified_checkpoint=Checkpoint.ssz_type,
+            finalized_checkpoint=Checkpoint.ssz_type,
+        ),
+    )
+
+    BeaconStateAltair = _make_state(
+        "BeaconStateAltair",
+        "altair",
+        dict(
+            previous_epoch_participation=List(
+                uint8, preset.validator_registry_limit
+            ),
+            current_epoch_participation=List(
+                uint8, preset.validator_registry_limit
+            ),
+            justification_bits=Bitvector(JUSTIFICATION_BITS_LENGTH),
+            previous_justified_checkpoint=Checkpoint.ssz_type,
+            current_justified_checkpoint=Checkpoint.ssz_type,
+            finalized_checkpoint=Checkpoint.ssz_type,
+            inactivity_scores=List(uint64, preset.validator_registry_limit),
+            current_sync_committee=SyncCommittee.ssz_type,
+            next_sync_committee=SyncCommittee.ssz_type,
+        ),
+    )
+
+    return SimpleNamespace(
+        preset=preset,
+        IndexedAttestation=IndexedAttestation,
+        AttesterSlashing=AttesterSlashing,
+        Attestation=Attestation,
+        PendingAttestation=PendingAttestation,
+        AggregateAndProof=AggregateAndProof,
+        SignedAggregateAndProof=SignedAggregateAndProof,
+        SyncAggregate=SyncAggregate,
+        SyncCommittee=SyncCommittee,
+        SyncCommitteeContribution=SyncCommitteeContribution,
+        ContributionAndProof=ContributionAndProof,
+        SignedContributionAndProof=SignedContributionAndProof,
+        HistoricalBatch=HistoricalBatch,
+        BeaconBlockBody=BeaconBlockBody,
+        BeaconBlockBodyAltair=BeaconBlockBodyAltair,
+        BeaconBlock=BeaconBlock,
+        SignedBeaconBlock=SignedBeaconBlock,
+        BeaconBlockAltair=BeaconBlockAltair,
+        SignedBeaconBlockAltair=SignedBeaconBlockAltair,
+        BeaconState=BeaconState,
+        BeaconStateAltair=BeaconStateAltair,
+    )
+
+
+def block_classes_for(t: SimpleNamespace, fork: str):
+    """(BeaconBlock, SignedBeaconBlock, BeaconBlockBody) for a fork name."""
+    if fork == "phase0":
+        return t.BeaconBlock, t.SignedBeaconBlock, t.BeaconBlockBody
+    if fork == "altair":
+        return t.BeaconBlockAltair, t.SignedBeaconBlockAltair, t.BeaconBlockBodyAltair
+    raise ValueError(f"unsupported fork {fork!r}")
+
+
+def state_class_for(t: SimpleNamespace, fork: str):
+    if fork == "phase0":
+        return t.BeaconState
+    if fork == "altair":
+        return t.BeaconStateAltair
+    raise ValueError(f"unsupported fork {fork!r}")

@@ -1,0 +1,43 @@
+"""Slot clocks (reference common/slot_clock: SystemTimeSlotClock +
+manual_slot_clock.rs for tests)."""
+
+from __future__ import annotations
+
+import time
+
+
+class SystemSlotClock:
+    def __init__(self, genesis_time: int, seconds_per_slot: int):
+        self.genesis_time = genesis_time
+        self.seconds_per_slot = seconds_per_slot
+
+    def current_slot(self) -> int:
+        now = time.time()
+        if now < self.genesis_time:
+            return 0
+        return int(now - self.genesis_time) // self.seconds_per_slot
+
+    def seconds_into_slot(self) -> float:
+        now = time.time()
+        return (now - self.genesis_time) % self.seconds_per_slot
+
+
+class ManualSlotClock:
+    """Test clock advanced by hand (manual_slot_clock.rs)."""
+
+    def __init__(self, genesis_time: int = 0, seconds_per_slot: int = 12):
+        self.genesis_time = genesis_time
+        self.seconds_per_slot = seconds_per_slot
+        self._slot = 0
+
+    def current_slot(self) -> int:
+        return self._slot
+
+    def set_slot(self, slot: int) -> None:
+        self._slot = slot
+
+    def advance_slot(self) -> None:
+        self._slot += 1
+
+    def seconds_into_slot(self) -> float:
+        return 0.0

@@ -1,0 +1,113 @@
+"""BeaconChain end-to-end tests over the in-process harness (the coverage
+role of reference beacon_chain/tests/{block_verification,store_tests}.rs +
+fork_choice/tests): multi-epoch finality, reorgs, store replay, pruning."""
+
+import pytest
+
+from lighthouse_tpu.chain import BlockError
+from lighthouse_tpu.crypto.bls import set_backend
+from lighthouse_tpu.harness import BeaconChainHarness
+from lighthouse_tpu.types import MINIMAL, ChainSpec
+
+SLOTS = MINIMAL.slots_per_epoch
+
+
+@pytest.fixture(autouse=True)
+def fake_crypto():
+    set_backend("fake")
+    yield
+    set_backend("jax_tpu")
+
+
+def make_harness(validators=64, fork="phase0"):
+    altair = 0 if fork == "altair" else None
+    return BeaconChainHarness(
+        validators, MINIMAL, ChainSpec.interop(altair_fork_epoch=altair)
+    )
+
+
+class TestImportPipeline:
+    def test_finality_over_four_epochs(self):
+        h = make_harness()
+        h.extend_chain(4 * SLOTS)
+        assert h.chain.justified_checkpoint[0] >= 2
+        assert h.finalized_epoch() >= 1
+
+    def test_duplicate_import_is_noop(self):
+        h = make_harness()
+        root = h.extend_chain(2)
+        state_before = h.chain.head_state.tree_hash_root()
+        blk = h.store.get_block(root)
+        assert h.chain.process_block(blk) == root
+        assert h.chain.head_state.tree_hash_root() == state_before
+
+    def test_unknown_parent_rejected(self):
+        h = make_harness()
+        signed, _ = h.producer.produce_block(1)
+        signed.message.parent_root = b"\x99" * 32
+        with pytest.raises(BlockError):
+            h.chain.process_block(signed)
+
+    def test_state_root_mismatch_rejected(self):
+        h = make_harness()
+        signed, _ = h.producer.produce_block(1)
+        signed.message.state_root = b"\x77" * 32
+        with pytest.raises(BlockError):
+            h.chain.process_block(signed)
+
+
+class TestForksAndReorg:
+    def test_fork_blocks_coexist(self):
+        h = make_harness()
+        base = h.extend_chain(2)
+        a = h.add_block_at_slot(4, parent_root=base, attest=False)
+        b = h.add_block_at_slot(3, parent_root=base, attest=False)
+        assert a in h.chain._states and b in h.chain._states
+        # head is one of the two forks, chosen by fork choice
+        assert h.chain.head_root in (a, b)
+
+    def test_attestations_drive_reorg(self):
+        h = make_harness()
+        base = h.extend_chain(2)
+        # two competing empty blocks
+        a = h.add_block_at_slot(3, parent_root=base, attest=False)
+        b = h.add_block_at_slot(4, parent_root=base, attest=False)
+        head_before = h.chain.head_root
+        loser = a if head_before == b else b
+        # a block on the losing fork carrying attestations for it reorgs
+        h.chain.slot_clock.set_slot(6)
+        h.chain.on_tick()
+        h.add_block_at_slot(6, parent_root=loser, attest=True)
+        new_head = h.chain.head_root
+        # the new head descends from the previously-losing fork
+        blk = h.store.get_block(new_head)
+        assert bytes(blk.message.parent_root) == loser
+
+
+class TestStore:
+    def test_state_reconstruction_by_replay(self):
+        h = make_harness()
+        h.extend_chain(SLOTS + 3)  # crosses a snapshot boundary
+        # pick a non-snapshot state: head at slot SLOTS+3
+        root = h.chain.head_state.tree_hash_root()
+        rebuilt = h.store.get_state(root)
+        assert rebuilt.tree_hash_root() == root
+
+    def test_finalized_blocks_move_to_freezer(self):
+        h = make_harness()
+        h.extend_chain(5 * SLOTS)
+        assert h.finalized_epoch() >= 1
+        from lighthouse_tpu.store.kv import Column
+
+        frozen = h.store.kv.keys(Column.FREEZER_BLOCK)
+        assert len(frozen) > 0
+        # frozen blocks remain readable through the any-temperature path
+        blk = h.store.get_block_any_temperature(frozen[0])
+        assert blk is not None
+
+
+class TestAltairChain:
+    def test_altair_finality(self):
+        h = make_harness(fork="altair")
+        h.extend_chain(4 * SLOTS)
+        assert h.finalized_epoch() >= 1

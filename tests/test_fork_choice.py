@@ -1,0 +1,130 @@
+"""Table-driven proto-array vote scenarios, mirroring the reference's
+embedded fork-choice test definitions (consensus/proto_array/src/
+fork_choice_test_definition/votes.rs -- no network, pure data)."""
+
+from lighthouse_tpu.fork_choice import ProtoArrayForkChoice
+
+GENESIS = b"\x00" * 32
+
+
+def root(n: int) -> bytes:
+    return n.to_bytes(32, "big")
+
+
+def make_fc():
+    jc = (1, GENESIS)
+    fc = (1, GENESIS)
+    return ProtoArrayForkChoice(0, GENESIS, jc, fc)
+
+
+def head(fc, balances):
+    return fc.find_head((1, GENESIS), (1, GENESIS), balances)
+
+
+class TestVoteScenarios:
+    def test_genesis_head(self):
+        fc = make_fc()
+        assert head(fc, []) == GENESIS
+
+    def test_single_chain_extends_head(self):
+        fc = make_fc()
+        fc.process_block(1, root(1), GENESIS, (1, GENESIS), (1, GENESIS))
+        fc.process_block(2, root(2), root(1), (1, GENESIS), (1, GENESIS))
+        assert head(fc, []) == root(2)
+
+    def test_tie_break_prefers_higher_root(self):
+        fc = make_fc()
+        fc.process_block(1, root(2), GENESIS, (1, GENESIS), (1, GENESIS))
+        fc.process_block(1, root(1), GENESIS, (1, GENESIS), (1, GENESIS))
+        assert head(fc, []) == root(2)
+
+    def test_votes_move_head(self):
+        fc = make_fc()
+        fc.process_block(1, root(1), GENESIS, (1, GENESIS), (1, GENESIS))
+        fc.process_block(1, root(2), GENESIS, (1, GENESIS), (1, GENESIS))
+        assert head(fc, [1, 1]) == root(2)  # tie -> higher root
+        # two votes for the lower root flip the head
+        fc.process_attestation(0, root(1), 2)
+        fc.process_attestation(1, root(1), 2)
+        assert head(fc, [1, 1]) == root(1)
+
+    def test_vote_change_moves_weight(self):
+        fc = make_fc()
+        fc.process_block(1, root(1), GENESIS, (1, GENESIS), (1, GENESIS))
+        fc.process_block(1, root(2), GENESIS, (1, GENESIS), (1, GENESIS))
+        fc.process_attestation(0, root(1), 2)
+        fc.process_attestation(1, root(1), 2)
+        assert head(fc, [1, 1]) == root(1)
+        # both validators switch in a later epoch
+        fc.process_attestation(0, root(2), 3)
+        fc.process_attestation(1, root(2), 3)
+        assert head(fc, [1, 1]) == root(2)
+
+    def test_stale_vote_ignored(self):
+        fc = make_fc()
+        fc.process_block(1, root(1), GENESIS, (1, GENESIS), (1, GENESIS))
+        fc.process_block(1, root(2), GENESIS, (1, GENESIS), (1, GENESIS))
+        fc.process_attestation(0, root(1), 5)
+        fc.process_attestation(0, root(2), 4)  # older epoch: ignored
+        assert head(fc, [1, 0]) == root(1)
+
+    def test_subtree_weight_beats_single_heavy_leaf(self):
+        # g -> a -> b, c ; votes on b and c together outweigh a sibling d
+        fc = make_fc()
+        fc.process_block(1, root(0xA), GENESIS, (1, GENESIS), (1, GENESIS))
+        fc.process_block(1, root(0xD), GENESIS, (1, GENESIS), (1, GENESIS))
+        fc.process_block(2, root(0xB), root(0xA), (1, GENESIS), (1, GENESIS))
+        fc.process_block(2, root(0xC), root(0xA), (1, GENESIS), (1, GENESIS))
+        fc.process_attestation(0, root(0xB), 2)
+        fc.process_attestation(1, root(0xC), 2)
+        fc.process_attestation(2, root(0xD), 2)
+        balances = [1, 1, 1]
+        # subtree under a has weight 2 > d's 1; within a, tie -> higher root
+        assert head(fc, balances) == root(0xC)
+
+    def test_balance_change_reweights(self):
+        fc = make_fc()
+        fc.process_block(1, root(1), GENESIS, (1, GENESIS), (1, GENESIS))
+        fc.process_block(1, root(2), GENESIS, (1, GENESIS), (1, GENESIS))
+        fc.process_attestation(0, root(1), 2)
+        fc.process_attestation(1, root(2), 2)
+        assert head(fc, [3, 1]) == root(1)
+        # validator 0 slashed/ejected: balance to zero
+        assert head(fc, [0, 1]) == root(2)
+
+    def test_viability_gate(self):
+        # a block with a different justified checkpoint can't be head while
+        # the store disagrees
+        fc = make_fc()
+        fc.process_block(1, root(1), GENESIS, (1, GENESIS), (1, GENESIS))
+        fc.process_block(2, root(2), root(1), (2, root(1)), (1, GENESIS))
+        assert head(fc, []) == root(1)  # root(2) not viable under (1, GENESIS)
+
+    def test_proposer_boost(self):
+        fc = make_fc()
+        fc.process_block(1, root(1), GENESIS, (1, GENESIS), (1, GENESIS))
+        fc.process_block(1, root(2), GENESIS, (1, GENESIS), (1, GENESIS))
+        fc.process_attestation(0, root(2), 2)
+        fc.proposer_boost_root = root(1)
+        got = fc.find_head((1, GENESIS), (1, GENESIS), [1], 10)
+        assert got == root(1)  # boost 10 > vote 1
+        # boost removed next call -> vote wins again
+        fc.proposer_boost_root = None
+        got = fc.find_head((1, GENESIS), (1, GENESIS), [1], 0)
+        assert got == root(2)
+
+    def test_prune_keeps_descendants(self):
+        fc = make_fc()
+        fc.proto_array.prune_threshold = 0
+        prev = GENESIS
+        for i in range(1, 6):
+            fc.process_block(i, root(i), prev, (1, GENESIS), (1, GENESIS))
+            prev = root(i)
+        fc.proto_array.maybe_prune(root(3))
+        assert root(2) not in fc.proto_array.indices
+        # best-descendant pointers refresh on the next score sweep (as in
+        # the reference: on_block only touches the immediate parent)
+        fc.proto_array.apply_score_changes(
+            [0] * len(fc.proto_array.nodes), (1, GENESIS), (1, GENESIS)
+        )
+        assert fc.proto_array.find_head(root(3)) == root(5)

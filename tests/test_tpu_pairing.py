@@ -2,94 +2,73 @@
 
 The TPU final exponentiation computes f^(3h) (x-chain; see pairing.py), so
 comparisons against the oracle pairing are done as cube-of-oracle.
-"""
+
+Two jitted kernels at ONE batch shape (4 pairs): the Miller loop and the
+batched final exponentiation. Pairing products are checked host-side on
+the oracle field (the single-shared-final-exp production path is exercised
+end-to-end by the jax_tpu backend tests in test_bls_api.py)."""
 
 import random
 
 import numpy as np
+import jax
 import jax.numpy as jnp
 
 from lighthouse_tpu.crypto.bls import curve_ref as C
 from lighthouse_tpu.crypto.bls import pairing_ref as PR
 from lighthouse_tpu.crypto.bls.constants import R
-from lighthouse_tpu.crypto.bls.fields_ref import Fp2
+from lighthouse_tpu.crypto.bls.fields_ref import Fp12
 from lighthouse_tpu.crypto.bls.tpu import curve as TC
 from lighthouse_tpu.crypto.bls.tpu import pairing as TP
 from lighthouse_tpu.crypto.bls.tpu import tower as T
 
 rng = random.Random(0xBEEF)
+B = 4  # pairs per batch -> one compile for each kernel
+
+jmiller = jax.jit(TP.miller_loop)
+jfinal = jax.jit(TP.final_exponentiation)
 
 
 def pack_pairs(pairs):
-    """[(P oracle G1 affine, Q oracle G2 affine)] -> device affine arrays."""
-    g1 = TC.g1_pack([p for p, _ in pairs])  # (n, 3, W) jac with z=1
+    assert len(pairs) == B
+    g1 = TC.g1_pack([p for p, _ in pairs])
     g2 = TC.g2_pack([q for _, q in pairs])
-    p_aff = g1[:, :2]
-    q_aff = g2[:, :2]
-    p_inf = jnp.asarray([p.inf for p, _ in pairs])
-    q_inf = jnp.asarray([q.inf for _, q in pairs])
-    return p_aff, p_inf, q_aff, q_inf
+    return (
+        g1[:, :2],
+        jnp.asarray([p.inf for p, _ in pairs]),
+        g2[:, :2],
+        jnp.asarray([q.inf for _, q in pairs]),
+    )
 
 
-def test_miller_loop_matches_oracle():
-    g1, g2 = C.g1_generator(), C.g2_generator()
-    pairs = [
-        (g1.mul(rng.randrange(1, R)), g2.mul(rng.randrange(1, R)))
-        for _ in range(2)
-    ]
-    pairs.append((C.Point(g1.x, g1.y, True), g2))  # P at infinity -> one
-    got = TP.miller_loop(*pack_pairs(pairs))
-    for i, (p, q) in enumerate(pairs):
-        want = PR.miller_loop(p, q)
-        # Lines differ from the oracle's by Fp2 scaling factors; compare
-        # after the easy part would also work, but full final exp is the
-        # real contract -- checked in test_pairing_matches_oracle. Here we
-        # check only the infinity case exactly.
-        if p.inf or q.inf:
-            assert T.fp12_to_ref(got[i]) == want
+def pairings_cubed(pairs):
+    """Device e(P,Q)^3 for each pair, via the two shared kernels."""
+    return jfinal(jmiller(*pack_pairs(pairs)))
 
 
-def test_pairing_matches_oracle_cubed():
+def test_pairing_matches_oracle_cubed_and_infinity():
     g1, g2 = C.g1_generator(), C.g2_generator()
     a, b = rng.randrange(1, R), rng.randrange(1, R)
-    pairs = [(g1, g2), (g1.mul(a), g2.mul(b))]
-    got = TP.pairing(*pack_pairs(pairs))
+    inf1 = C.Point(g1.x, g1.y, True)
+    pairs = [(g1, g2), (g1.mul(a), g2.mul(b)), (inf1, g2), (g1, g2.mul(b))]
+    got = pairings_cubed(pairs)
     for i, (p, q) in enumerate(pairs):
         want = PR.pairing(p, q).pow(3)
         assert T.fp12_to_ref(got[i]) == want
 
 
-def test_bilinearity_on_device():
+def test_bilinearity_and_product():
     g1, g2 = C.g1_generator(), C.g2_generator()
     a, b = rng.randrange(1, R), rng.randrange(1, R)
-    # e([a]P, [b]Q) == e([ab]P, Q)
-    pairs1 = [(g1.mul(a), g2.mul(b))]
-    pairs2 = [(g1.mul(a * b % R), g2)]
-    f1 = TP.pairing(*pack_pairs(pairs1))
-    f2 = TP.pairing(*pack_pairs(pairs2))
-    assert bool(np.asarray(T.fp12_eq(f1, f2))[0])
-
-
-def test_multi_pairing_product_is_one():
-    # e(P, Q) * e(-P, Q) == 1, plus an infinity pair contributing nothing
-    g1, g2 = C.g1_generator(), C.g2_generator()
-    a = rng.randrange(1, R)
     p = g1.mul(a)
-    q = g2.mul(rng.randrange(1, R))
-    inf1 = C.Point(p.x, p.y, True)
-    pairs = [(p, q), (-p, q), (inf1, q), (inf1, q)]
-    assert bool(np.asarray(TP.multi_pairing_is_one(*pack_pairs(pairs))))
-
-    bad = [(p, q), (p, q), (inf1, q), (inf1, q)]
-    assert not bool(np.asarray(TP.multi_pairing_is_one(*pack_pairs(bad))))
-
-
-def test_multi_pairing_matches_oracle():
-    g1, g2 = C.g1_generator(), C.g2_generator()
+    q = g2.mul(b)
     pairs = [
-        (g1.mul(rng.randrange(1, R)), g2.mul(rng.randrange(1, R)))
-        for _ in range(3)
+        (g1.mul(a), g2.mul(b)),    # e([a]G1, [b]G2)
+        (g1.mul(a * b % R), g2),   # e([ab]G1, G2) -- must equal pairs[0]
+        (p, q),                    # e(P, Q)
+        (-p, q),                   # e(-P, Q) -- must invert pairs[2]
     ]
-    got = TP.multi_pairing(*pack_pairs(pairs))
-    want = PR.multi_pairing(pairs).pow(3)
-    assert T.fp12_to_ref(got) == want
+    f = pairings_cubed(pairs)
+    r0, r1, r2, r3 = (T.fp12_to_ref(f[i]) for i in range(B))
+    assert r0 == r1
+    assert r2 * r3 == Fp12.one()  # product-of-pairings neutrality

@@ -1,0 +1,112 @@
+"""Consensus-type tests: container round trips, state roots, committee
+cache consistency, interop genesis (coverage style of the reference's
+consensus/types tests + ssz_static round-trip vectors)."""
+
+import pytest
+
+from lighthouse_tpu.types import (
+    ChainSpec,
+    CommitteeCache,
+    MINIMAL,
+    compute_domain,
+    compute_epoch_at_slot,
+    compute_signing_root,
+    interop_genesis_state,
+    interop_keypair,
+    types_for,
+)
+from lighthouse_tpu.types.containers import Validator
+from lighthouse_tpu.types.helpers import get_active_validator_indices
+
+SPEC = ChainSpec.interop()
+T = types_for(MINIMAL)
+
+
+@pytest.fixture(scope="module")
+def genesis():
+    return interop_genesis_state(32, MINIMAL, SPEC)
+
+
+class TestContainers:
+    def test_attestation_round_trip(self):
+        att = T.Attestation(
+            aggregation_bits=(True, False, True, True),
+            data=__import__(
+                "lighthouse_tpu.types", fromlist=["AttestationData"]
+            ).AttestationData(slot=3, index=1),
+            signature=b"\x05" * 96,
+        )
+        assert T.Attestation.from_ssz_bytes(att.as_ssz_bytes()) == att
+
+    def test_block_round_trip_both_forks(self):
+        for blk_cls, body_cls in [
+            (T.SignedBeaconBlock, T.BeaconBlockBody),
+            (T.SignedBeaconBlockAltair, T.BeaconBlockBodyAltair),
+        ]:
+            blk = blk_cls.default()
+            blk.message.slot = 9
+            blk.message.body = body_cls.default()
+            data = blk.as_ssz_bytes()
+            assert blk_cls.from_ssz_bytes(data) == blk
+
+    def test_state_round_trip(self, genesis):
+        data = genesis.as_ssz_bytes()
+        back = type(genesis).from_ssz_bytes(data)
+        assert back == genesis
+        assert back.tree_hash_root() == genesis.tree_hash_root()
+
+    def test_validator_fixed_size(self):
+        assert Validator.ssz_type.is_fixed()
+        assert Validator.ssz_type.fixed_size() == 121
+
+
+class TestGenesis:
+    def test_all_validators_active(self, genesis):
+        assert len(genesis.validators) == 32
+        assert get_active_validator_indices(genesis, 0) == list(range(32))
+
+    def test_pubkeys_match_interop_keys(self, genesis):
+        for i in (0, 7, 31):
+            _, pk = interop_keypair(i)
+            assert bytes(genesis.validators[i].pubkey) == pk.to_bytes()
+
+    def test_genesis_validators_root_nonzero(self, genesis):
+        assert genesis.genesis_validators_root != bytes(32)
+
+
+class TestCommittees:
+    def test_cache_covers_every_validator_once(self, genesis):
+        cache = CommitteeCache(genesis, 0, MINIMAL, SPEC)
+        seen = []
+        for slot in range(MINIMAL.slots_per_epoch):
+            for committee in cache.get_all_committees_at_slot(slot):
+                seen.extend(committee)
+        assert sorted(seen) == list(range(32))
+
+    def test_reverse_map_agrees(self, genesis):
+        cache = CommitteeCache(genesis, 0, MINIMAL, SPEC)
+        slot_off, ci, pos = cache.attester_position(5)
+        committee = cache.get_beacon_committee(slot_off, ci)
+        assert committee[pos] == 5
+
+    def test_epoch_mismatch_rejected(self, genesis):
+        cache = CommitteeCache(genesis, 0, MINIMAL, SPEC)
+        with pytest.raises(ValueError):
+            cache.get_beacon_committee(MINIMAL.slots_per_epoch, 0)
+
+
+class TestDomains:
+    def test_signing_root_changes_with_domain(self):
+        from lighthouse_tpu.types import (
+            DOMAIN_BEACON_PROPOSER,
+            DOMAIN_RANDAO,
+            AttestationData,
+        )
+
+        obj = AttestationData(slot=1, index=0)
+        d1 = compute_domain(DOMAIN_BEACON_PROPOSER, b"\x00" * 4, bytes(32))
+        d2 = compute_domain(DOMAIN_RANDAO, b"\x00" * 4, bytes(32))
+        assert compute_signing_root(obj, d1) != compute_signing_root(obj, d2)
+
+    def test_epoch_math(self):
+        assert compute_epoch_at_slot(17, MINIMAL) == 2

@@ -1,0 +1,248 @@
+"""Gossip attestation verification with batched TPU signature checks
+(reference beacon_node/beacon_chain/src/attestation_verification.rs +
+attestation_verification/batch.rs:31-222).
+
+Pipeline per the reference's typestate flow: cheap early checks (slot
+window, structure, first-seen dedup, committee lookup) run per item; all
+surviving items' signature sets go to the backend in ONE
+verify_signature_sets call (1 set per unaggregated attestation; 3 per
+aggregate: selection proof, aggregate signature, indexed attestation);
+a batch failure falls back to per-item verification so one bad item
+cannot censor the rest (batch.rs:122-133).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+
+from ..crypto.bls import verify_signature_sets
+from ..state_transition.context import ConsensusContext
+from ..state_transition.signature_sets import (
+    aggregate_and_proof_signature_set,
+    indexed_attestation_signature_set,
+    selection_proof_signature_set,
+    state_pubkey_getter,
+)
+from ..types import compute_epoch_at_slot
+from ..types.helpers import hash32
+
+ATTESTATION_PROPAGATION_SLOT_RANGE = 32
+
+
+class AttestationError(ValueError):
+    def __init__(self, reason: str):
+        super().__init__(reason)
+        self.reason = reason
+
+
+@dataclass
+class VerifiedUnaggregated:
+    attestation: object
+    indexed_indices: list
+    attester_index: int
+
+
+@dataclass
+class VerifiedAggregate:
+    signed_aggregate: object
+    indexed_indices: list
+
+
+def is_aggregator(committee_len: int, selection_proof: bytes, spec) -> bool:
+    """Spec is_aggregator: hash(selection_proof) picks ~TARGET_AGGREGATORS
+    members per committee."""
+    modulo = max(
+        1, committee_len // spec.target_aggregators_per_committee
+    )
+    return (
+        int.from_bytes(hash32(bytes(selection_proof))[:8], "little") % modulo
+        == 0
+    )
+
+
+def _early_checks_unaggregated(chain, attestation):
+    data = attestation.data
+    current = chain.current_slot
+    if not (
+        data.slot
+        <= current
+        <= data.slot + ATTESTATION_PROPAGATION_SLOT_RANGE
+    ):
+        raise AttestationError("outside propagation slot range")
+    if data.target.epoch != compute_epoch_at_slot(data.slot, chain.preset):
+        raise AttestationError("target epoch does not match slot")
+    bits = list(attestation.aggregation_bits)
+    if sum(bits) != 1:
+        raise AttestationError("not exactly one aggregation bit")
+    if bytes(data.beacon_block_root) not in chain._states:
+        raise AttestationError("unknown head block")
+    return bits.index(True)
+
+
+def batch_verify_unaggregated(
+    chain, attestations, observed_attesters, ctxt: ConsensusContext | None = None
+):
+    """[(attestation)] -> (verified: [VerifiedUnaggregated],
+    rejected: [(attestation, reason)]). ONE backend call for the batch
+    (beacon_chain.rs:1696 batch_verify_unaggregated_attestations_for_gossip).
+    """
+    ctxt = ctxt or ConsensusContext(chain.preset, chain.spec)
+    state = chain.head_state
+    get_pubkey = state_pubkey_getter(state)
+
+    survivors = []
+    rejected = []
+    batch_seen: set = set()
+    for att in attestations:
+        try:
+            pos = _early_checks_unaggregated(chain, att)
+            cache = ctxt.committee_cache(state, att.data.target.epoch)
+            committee = cache.get_beacon_committee(
+                att.data.slot, att.data.index
+            )
+            if len(committee) != len(list(att.aggregation_bits)):
+                raise AttestationError("bits/committee length mismatch")
+            attester = committee[pos]
+            # peek only: marking happens AFTER signature verification, so a
+            # forged message cannot censor the real one (the reference
+            # observes post-verification for the same reason)
+            key = (att.data.target.epoch, attester)
+            if (
+                observed_attesters.is_known(*key) or key in batch_seen
+            ):
+                raise AttestationError("attester already seen this epoch")
+            batch_seen.add(key)
+            indexed = ctxt.get_indexed_attestation(state, att)
+            s = indexed_attestation_signature_set(
+                state, get_pubkey, indexed, chain.preset, chain.spec
+            )
+            survivors.append(
+                (att, s, list(indexed.attesting_indices), attester)
+            )
+        except (AttestationError, ValueError) as e:
+            rejected.append((att, str(e)))
+
+    verified = []
+    if survivors:
+        sets = [s for _, s, _, _ in survivors]
+        if verify_signature_sets(sets):
+            ok_items = survivors
+        else:
+            # fallback: re-verify per item (batch.rs:122-133)
+            ok_items = []
+            for item in survivors:
+                if verify_signature_sets([item[1]]):
+                    ok_items.append(item)
+                else:
+                    rejected.append((item[0], "invalid signature"))
+        for att, _, indices, attester in ok_items:
+            observed_attesters.observe(att.data.target.epoch, attester)
+            verified.append(VerifiedUnaggregated(att, indices, attester))
+    return verified, rejected
+
+
+def _early_checks_aggregate(
+    chain, signed_aggregate, observed_aggregates, observed_aggregators, ctxt
+):
+    msg = signed_aggregate.message
+    data = msg.aggregate.data
+    current = chain.current_slot
+    if not (
+        data.slot <= current <= data.slot + ATTESTATION_PROPAGATION_SLOT_RANGE
+    ):
+        raise AttestationError("outside propagation slot range")
+    # epoch sanity BEFORE it touches any cache (an attacker-chosen epoch
+    # must never drive cache pruning)
+    epoch = data.target.epoch
+    if epoch != compute_epoch_at_slot(data.slot, chain.preset):
+        raise AttestationError("target epoch does not match slot")
+    if not any(msg.aggregate.aggregation_bits):
+        raise AttestationError("empty aggregation bits")
+    if bytes(data.beacon_block_root) not in chain._states:
+        raise AttestationError("unknown head block")
+    agg_root = msg.aggregate.tree_hash_root()
+    # peek only; marking happens post-verification
+    if observed_aggregates.is_known(epoch, agg_root):
+        raise AttestationError("aggregate already seen")
+    if observed_aggregators.is_known(epoch, msg.aggregator_index):
+        raise AttestationError("aggregator already seen this epoch")
+    state = chain.head_state
+    cache = ctxt.committee_cache(state, epoch)
+    committee = cache.get_beacon_committee(data.slot, data.index)
+    if msg.aggregator_index not in committee:
+        raise AttestationError("aggregator not in committee")
+    if not is_aggregator(
+        len(committee), msg.selection_proof, chain.spec
+    ):
+        raise AttestationError("invalid aggregator selection")
+    return agg_root
+
+
+def batch_verify_aggregates(
+    chain,
+    signed_aggregates,
+    observed_aggregates,
+    observed_aggregators,
+    ctxt: ConsensusContext | None = None,
+):
+    """Batched aggregate-and-proof verification: THREE sets per item
+    (selection proof, aggregate-and-proof signature, indexed attestation;
+    batch.rs:77-107), one backend call, per-item fallback."""
+    ctxt = ctxt or ConsensusContext(chain.preset, chain.spec)
+    state = chain.head_state
+    get_pubkey = state_pubkey_getter(state)
+
+    survivors = []
+    rejected = []
+    batch_seen: set = set()
+    for agg in signed_aggregates:
+        try:
+            agg_root = _early_checks_aggregate(
+                chain, agg, observed_aggregates, observed_aggregators, ctxt
+            )
+            epoch = agg.message.aggregate.data.target.epoch
+            keys = (
+                (epoch, agg_root),
+                (epoch, agg.message.aggregator_index),
+            )
+            if any(k in batch_seen for k in keys):
+                raise AttestationError("aggregate already seen")
+            batch_seen.update(keys)
+            indexed = ctxt.get_indexed_attestation(
+                state, agg.message.aggregate
+            )
+            sets = [
+                selection_proof_signature_set(
+                    state, get_pubkey, agg, chain.preset, chain.spec
+                ),
+                aggregate_and_proof_signature_set(
+                    state, get_pubkey, agg, chain.preset, chain.spec
+                ),
+                indexed_attestation_signature_set(
+                    state, get_pubkey, indexed, chain.preset, chain.spec
+                ),
+            ]
+            survivors.append((agg, sets, list(indexed.attesting_indices)))
+        except (AttestationError, ValueError) as e:
+            rejected.append((agg, str(e)))
+
+    verified = []
+    if survivors:
+        all_sets = [s for _, sets, _ in survivors for s in sets]
+        if verify_signature_sets(all_sets):
+            ok_items = survivors
+        else:
+            ok_items = []
+            for item in survivors:
+                if verify_signature_sets(item[1]):
+                    ok_items.append(item)
+                else:
+                    rejected.append((item[0], "invalid signature"))
+        for agg, _, indices in ok_items:
+            epoch = agg.message.aggregate.data.target.epoch
+            observed_aggregates.observe(
+                epoch, agg.message.aggregate.tree_hash_root()
+            )
+            observed_aggregators.observe(epoch, agg.message.aggregator_index)
+            verified.append(VerifiedAggregate(agg, indices))
+    return verified, rejected

@@ -82,13 +82,10 @@ class StateHarness:
 
     # -- attestations --------------------------------------------------------
 
-    def attestations_for_slot(self, state, slot: int):
-        """Full-participation attestations for every committee at `slot`
-        (state must be at or past `slot`)."""
-        t = types_for(self.preset)
+    def attestation_data_for(self, state, slot: int, index: int):
+        """Spec-consistent AttestationData for (slot, committee index) as
+        seen from `state` (at or past `slot`)."""
         epoch = compute_epoch_at_slot(slot, self.preset)
-        ctxt = ConsensusContext(self.preset, self.spec)
-        cache = ctxt.committee_cache(state, epoch)
         head_root = get_block_root_at_slot(state, slot, self.preset)
         target_slot = compute_start_slot_at_epoch(epoch, self.preset)
         target_root = (
@@ -100,16 +97,25 @@ class StateHarness:
             source = state.current_justified_checkpoint
         else:
             source = state.previous_justified_checkpoint
+        return AttestationData(
+            slot=slot,
+            index=index,
+            beacon_block_root=head_root,
+            source=source,
+            target=Checkpoint(epoch=epoch, root=target_root),
+        )
+
+    def attestations_for_slot(self, state, slot: int):
+        """Full-participation attestations for every committee at `slot`
+        (state must be at or past `slot`)."""
+        t = types_for(self.preset)
+        epoch = compute_epoch_at_slot(slot, self.preset)
+        ctxt = ConsensusContext(self.preset, self.spec)
+        cache = ctxt.committee_cache(state, epoch)
         out = []
         for index in range(cache.committees_per_slot):
             committee = cache.get_beacon_committee(slot, index)
-            data = AttestationData(
-                slot=slot,
-                index=index,
-                beacon_block_root=head_root,
-                source=source,
-                target=Checkpoint(epoch=epoch, root=target_root),
-            )
+            data = self.attestation_data_for(state, slot, index)
             if self.sign:
                 domain = get_domain(
                     state, DOMAIN_BEACON_ATTESTER, epoch, self.preset
@@ -132,6 +138,74 @@ class StateHarness:
                 )
             )
         return out
+
+    def make_unaggregated(self, state, slot: int, index: int, position: int):
+        """Single-bit attestation from committee member at `position`
+        (what a validator publishes to the subnet)."""
+        ctxt = ConsensusContext(self.preset, self.spec)
+        committee = ctxt.committee_cache(
+            state, compute_epoch_at_slot(slot, self.preset)
+        ).get_beacon_committee(slot, index)
+        bits = tuple(i == position for i in range(len(committee)))
+        data = self.attestation_data_for(state, slot, index)
+        if self.sign:
+            domain = get_domain(
+                state,
+                DOMAIN_BEACON_ATTESTER,
+                data.target.epoch,
+                self.preset,
+            )
+            sig = self._sign_root(
+                compute_signing_root(data, domain), committee[position]
+            )
+        else:
+            sig = INFINITY_SIGNATURE
+        t = types_for(self.preset)
+        return t.Attestation(
+            aggregation_bits=bits, data=data, signature=sig
+        )
+
+    def make_signed_aggregate(self, state, slot: int, index: int):
+        """Full-participation SignedAggregateAndProof from the first
+        committee member that passes is_aggregator with a REAL selection
+        proof (the aggregation duty path)."""
+        from ..chain.attestation_verification import is_aggregator
+        from ..types.chain_spec import (
+            DOMAIN_AGGREGATE_AND_PROOF,
+            DOMAIN_SELECTION_PROOF,
+        )
+
+        aggregate = self.attestations_for_slot(state, slot)[index]
+        ctxt = ConsensusContext(self.preset, self.spec)
+        epoch = compute_epoch_at_slot(slot, self.preset)
+        committee = ctxt.committee_cache(state, epoch).get_beacon_committee(
+            slot, index
+        )
+        sel_domain = get_domain(
+            state, DOMAIN_SELECTION_PROOF, epoch, self.preset
+        )
+        sel_root = SigningData(
+            object_root=uint64.hash_tree_root(slot), domain=sel_domain
+        ).tree_hash_root()
+        for aggregator in committee:
+            proof = self._sign_root(sel_root, aggregator)
+            if is_aggregator(len(committee), proof, self.spec):
+                break
+        else:
+            raise RuntimeError("no aggregator found in committee")
+        t = types_for(self.preset)
+        msg = t.AggregateAndProof(
+            aggregator_index=aggregator,
+            aggregate=aggregate,
+            selection_proof=proof,
+        )
+        agg_domain = get_domain(
+            state, DOMAIN_AGGREGATE_AND_PROOF, epoch, self.preset
+        )
+        sig = self._sign_root(
+            compute_signing_root(msg, agg_domain), aggregator
+        )
+        return t.SignedAggregateAndProof(message=msg, signature=sig)
 
     # -- block production ----------------------------------------------------
 

@@ -1,0 +1,4 @@
+"""Work scheduling (reference beacon_node/network/src/beacon_processor):
+prioritized bounded queues forming TPU-sized verification batches."""
+
+from .beacon_processor import BeaconProcessor, WorkQueue  # noqa: F401

@@ -1,0 +1,17 @@
+"""Validator client (reference validator_client/, SURVEY.md section 2.4):
+duty services, signing store with slashing protection, beacon-node
+fallback, doppelganger protection."""
+
+from .beacon_node import InProcessBeaconNode  # noqa: F401
+from .services import (  # noqa: F401
+    BeaconNodeFallback,
+    DutiesService,
+    NoHealthyBeaconNode,
+    ValidatorClient,
+)
+from .slashing_protection import NotSafe, SlashingDatabase  # noqa: F401
+from .validator_store import (  # noqa: F401
+    DoppelgangerHold,
+    LocalKeystore,
+    ValidatorStore,
+)

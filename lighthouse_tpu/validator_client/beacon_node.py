@@ -1,0 +1,190 @@
+"""The VC-facing beacon node interface (reference common/eth2's
+BeaconNodeHttpClient surface, consumed by validator_client services).
+`InProcessBeaconNode` implements it directly over a local BeaconChain --
+the same duck type an HTTP client implements over the wire, so services
+are transport-agnostic (the reference's BN<->VC process boundary)."""
+
+from __future__ import annotations
+
+from ..chain.beacon_chain import BeaconChain
+from ..pool import NaiveAggregationPool, OperationPool
+from ..state_transition import (
+    BlockSignatureStrategy,
+    ConsensusContext,
+    clone_state,
+    get_beacon_proposer_index,
+    per_block_processing,
+    process_slots,
+)
+from ..types import (
+    compute_epoch_at_slot,
+    compute_start_slot_at_epoch,
+    types_for,
+)
+from ..types.containers import block_classes_for
+from ..types.presets import Preset
+
+
+class InProcessBeaconNode:
+    def __init__(
+        self,
+        chain: BeaconChain,
+        op_pool: OperationPool | None = None,
+        naive_pool: NaiveAggregationPool | None = None,
+    ):
+        self.chain = chain
+        self.preset: Preset = chain.preset
+        self.spec = chain.spec
+        self.op_pool = op_pool or OperationPool(chain.preset, chain.spec)
+        self.naive_pool = naive_pool or NaiveAggregationPool()
+        self.healthy = True  # toggled by tests to exercise VC failover
+
+    # -- status --------------------------------------------------------------
+
+    def is_healthy(self) -> bool:
+        return self.healthy
+
+    def genesis_validators_root(self) -> bytes:
+        return bytes(self.chain.head_state.genesis_validators_root)
+
+    def head_slot(self) -> int:
+        return self.chain.head_state.slot
+
+    # -- duties (the endpoints duties_service.rs:356-765 polls) -------------
+
+    def get_proposer_duties(self, epoch: int) -> list[tuple[int, int]]:
+        """[(slot, proposer_index)] for every slot of `epoch`."""
+        state = clone_state(self.chain.head_state)
+        start = compute_start_slot_at_epoch(epoch, self.preset)
+        if state.slot < start:
+            state = process_slots(state, start, self.preset, self.spec)
+        out = []
+        saved = state.slot
+        for slot in range(start, start + self.preset.slots_per_epoch):
+            # proposer selection hashes the exact slot into the epoch seed;
+            # the rest of the state is slot-independent within the epoch
+            state.slot = slot
+            out.append(
+                (slot, get_beacon_proposer_index(state, self.preset, self.spec))
+            )
+        state.slot = saved
+        return out
+
+    def get_attester_duties(self, epoch: int, indices) -> list[dict]:
+        state = clone_state(self.chain.head_state)
+        target = compute_start_slot_at_epoch(epoch, self.preset)
+        if state.slot < target:
+            state = process_slots(state, target, self.preset, self.spec)
+        ctxt = ConsensusContext(self.preset, self.spec)
+        cache = ctxt.committee_cache(state, epoch)
+        duties = []
+        wanted = set(indices)
+        for slot_off in range(self.preset.slots_per_epoch):
+            slot = target + slot_off
+            for ci in range(cache.committees_per_slot):
+                committee = cache.get_beacon_committee(slot, ci)
+                for pos, v in enumerate(committee):
+                    if v in wanted:
+                        duties.append(
+                            {
+                                "validator_index": v,
+                                "slot": slot,
+                                "committee_index": ci,
+                                "committee_position": pos,
+                                "committee_length": len(committee),
+                                "committees_at_slot": cache.committees_per_slot,
+                            }
+                        )
+        return duties
+
+    # -- block production/publish (block_service path) ----------------------
+
+    def produce_block(self, slot: int, randao_reveal: bytes, graffiti=b""):
+        """Unsigned block with pool-packed operations (the reference's
+        produce_block endpoint -> op_pool.get_attestations packing)."""
+        state = self.chain.state_for_block_production(slot)
+        fork = state.fork_name
+        t = types_for(self.preset)
+        block_cls, signed_cls, body_cls = block_classes_for(t, fork)
+        proposer = get_beacon_proposer_index(state, self.preset, self.spec)
+
+        body = body_cls.default()
+        body.randao_reveal = bytes(randao_reveal)
+        body.eth1_data = state.eth1_data
+        body.graffiti = bytes(graffiti).ljust(32, b"\x00")[:32]
+        body.attestations = tuple(self.op_pool.get_attestations(state))
+        prop, att, exits = self.op_pool.get_slashings_and_exits(state)
+        body.proposer_slashings = tuple(prop)
+        body.attester_slashings = tuple(att)
+        body.voluntary_exits = tuple(exits)
+        if hasattr(body, "sync_aggregate"):
+            from ..crypto.bls import INFINITY_SIGNATURE
+
+            body.sync_aggregate.sync_committee_signature = INFINITY_SIGNATURE
+
+        block = block_cls(
+            slot=slot,
+            proposer_index=proposer,
+            parent_root=state.latest_block_header.tree_hash_root(),
+            state_root=bytes(32),
+            body=body,
+        )
+        # state-root fill via scratch application
+        scratch = clone_state(state)
+        from ..crypto.bls import INFINITY_SIGNATURE
+
+        per_block_processing(
+            scratch,
+            signed_cls(message=block, signature=INFINITY_SIGNATURE),
+            self.preset,
+            self.spec,
+            strategy=BlockSignatureStrategy.NO_VERIFICATION,
+            verified_proposer_index=proposer,
+        )
+        block.state_root = scratch.tree_hash_root()
+        return block
+
+    def publish_block(self, signed_block) -> bytes:
+        return self.chain.process_block(signed_block)
+
+    # -- attestation endpoints ----------------------------------------------
+
+    def produce_attestation_data(self, slot: int, committee_index: int):
+        """AttestationData for (slot, index) on the current head."""
+        from ..types.containers import AttestationData, Checkpoint
+        from ..types.helpers import get_block_root_at_slot
+
+        state = self.chain.head_state
+        head_root = self.chain.head_root
+        if state.slot < slot:
+            state = process_slots(
+                clone_state(state), slot, self.preset, self.spec
+            )
+        epoch = compute_epoch_at_slot(slot, self.preset)
+        target_slot = compute_start_slot_at_epoch(epoch, self.preset)
+        if target_slot >= state.slot:
+            target_root = head_root
+        else:
+            target_root = get_block_root_at_slot(
+                state, target_slot, self.preset
+            )
+        return AttestationData(
+            slot=slot,
+            index=committee_index,
+            beacon_block_root=head_root,
+            source=state.current_justified_checkpoint,
+            target=Checkpoint(epoch=epoch, root=target_root),
+        )
+
+    def publish_attestation(self, attestation) -> None:
+        """Accept a signed unaggregated attestation into the pools + fork
+        choice (the gossip-equivalent ingestion path)."""
+        self.naive_pool.insert(attestation)
+        self.op_pool.insert_attestation(attestation)
+
+    def get_aggregate(self, data):
+        t = types_for(self.preset)
+        return self.naive_pool.get_aggregate(t, data)
+
+    def publish_aggregate_and_proof(self, signed_aggregate) -> None:
+        self.op_pool.insert_attestation(signed_aggregate.message.aggregate)

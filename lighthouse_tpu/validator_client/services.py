@@ -1,0 +1,266 @@
+"""Validator-client duty services (reference validator_client/src/:
+duties_service.rs:236-765, attestation_service.rs, block_service.rs,
+doppelganger_service.rs, beacon_node_fallback.rs:293).
+
+The services are synchronous per-slot steppers driven by a clock
+(`ValidatorClient.on_slot`), mirroring the reference's slot-timer tasks:
+block at slot start, attestations at 1/3 slot, aggregates at 2/3 slot,
+duty polling per epoch."""
+
+from __future__ import annotations
+
+from ..chain.attestation_verification import is_aggregator
+from ..types import compute_epoch_at_slot, types_for
+from ..types.presets import Preset
+from .validator_store import DoppelgangerHold, ValidatorStore
+from .slashing_protection import NotSafe
+
+
+class NoHealthyBeaconNode(RuntimeError):
+    pass
+
+
+class BeaconNodeFallback:
+    """Ranked multi-BN redundancy (beacon_node_fallback.rs:293-300):
+    first healthy candidate wins; candidates re-rank on failure."""
+
+    def __init__(self, candidates):
+        self.candidates = list(candidates)
+
+    def best(self):
+        for node in self.candidates:
+            if node.is_healthy():
+                return node
+        raise NoHealthyBeaconNode("no healthy beacon node available")
+
+    def call(self, fn):
+        last_err = None
+        for node in list(self.candidates):
+            if not node.is_healthy():
+                continue
+            try:
+                return fn(node)
+            except Exception as e:  # noqa: BLE001 -- reference retries broadly
+                last_err = e
+        if last_err is not None:
+            raise last_err
+        raise NoHealthyBeaconNode("no healthy beacon node available")
+
+
+class DutiesService:
+    """Maintains proposer/attester duty maps per epoch
+    (duties_service.rs:236,356,460,765)."""
+
+    def __init__(self, store: ValidatorStore, nodes: BeaconNodeFallback):
+        self.store = store
+        self.nodes = nodes
+        self.proposers: dict[int, list[tuple[int, int]]] = {}
+        self.attesters: dict[int, list[dict]] = {}
+        self._polled: set[int] = set()
+
+    def our_indices(self) -> set[int]:
+        out = set()
+        for pk in self.store.voting_pubkeys():
+            idx = self.store.validator_index(pk)
+            if idx is not None:
+                out.add(idx)
+        return out
+
+    def poll(self, epoch: int) -> None:
+        """Fetch duties for `epoch` and `epoch + 1` (the reference's
+        lookahead) if not already known."""
+        node = self.nodes.best()
+        # resolve unknown validator indices first (poll_validator_indices)
+        state = node.chain.head_state
+        pubkey_to_index = {
+            bytes(v.pubkey): i for i, v in enumerate(state.validators)
+        }
+        for pk in self.store.voting_pubkeys():
+            if self.store.validator_index(pk) is None:
+                idx = pubkey_to_index.get(pk)
+                if idx is not None:
+                    self.store.set_index(pk, idx)
+        for e in (epoch, epoch + 1):
+            if e in self._polled:
+                continue
+            self.proposers[e] = node.get_proposer_duties(e)
+            self.attesters[e] = node.get_attester_duties(
+                e, sorted(self.our_indices())
+            )
+            self._polled.add(e)
+
+    def block_proposal_duty(self, slot: int, preset: Preset):
+        epoch = compute_epoch_at_slot(slot, preset)
+        ours = self.our_indices()
+        for duty_slot, proposer in self.proposers.get(epoch, []):
+            if duty_slot == slot and proposer in ours:
+                return proposer
+        return None
+
+    def attestation_duties_at(self, slot: int, preset: Preset):
+        epoch = compute_epoch_at_slot(slot, preset)
+        return [
+            d for d in self.attesters.get(epoch, []) if d["slot"] == slot
+        ]
+
+
+class ValidatorClient:
+    """ProductionValidatorClient equivalent (validator_client/src/lib.rs:86):
+    owns the store and services; `on_slot` performs every duty for the
+    slot in the reference's intra-slot order."""
+
+    def __init__(
+        self,
+        store: ValidatorStore,
+        nodes: BeaconNodeFallback,
+        preset: Preset,
+        spec,
+    ):
+        self.store = store
+        self.nodes = nodes
+        self.preset = preset
+        self.spec = spec
+        self.duties = DutiesService(store, nodes)
+        self.blocks_proposed: list[bytes] = []
+        self.attestations_published = 0
+        self.aggregates_published = 0
+        self.doppelganger_detected: list[bytes] = []
+        self._dg_start: dict[bytes, int] = {}
+
+    def _pubkey_for_index(self, index: int) -> bytes | None:
+        for pk in self.store.voting_pubkeys():
+            if self.store.validator_index(pk) == index:
+                return pk
+        return None
+
+    # -- per-slot duty execution --------------------------------------------
+
+    def on_slot(self, slot: int) -> None:
+        epoch = compute_epoch_at_slot(slot, self.preset)
+        self.duties.poll(epoch)
+        self._doppelganger_scan(epoch)
+        self._block_duty(slot)
+        self._attestation_duty(slot)
+        self._aggregation_duty(slot)
+
+    def _block_duty(self, slot: int) -> None:
+        proposer = self.duties.block_proposal_duty(slot, self.preset)
+        if proposer is None:
+            return
+        pubkey = self._pubkey_for_index(proposer)
+        node = self.nodes.best()
+        state = node.chain.head_state
+        epoch = compute_epoch_at_slot(slot, self.preset)
+        try:
+            randao = self.store.sign_randao(pubkey, epoch, state)
+            block = node.produce_block(slot, randao.to_bytes())
+            sig = self.store.sign_block(pubkey, block, state)
+        except (NotSafe, DoppelgangerHold):
+            return
+        t = types_for(self.preset)
+        from ..types.containers import block_classes_for
+
+        _, signed_cls, _ = block_classes_for(t, type(block).fork_name)
+        root = node.publish_block(
+            signed_cls(message=block, signature=sig.to_bytes())
+        )
+        self.blocks_proposed.append(root)
+
+    def _attestation_duty(self, slot: int) -> None:
+        duties = self.duties.attestation_duties_at(slot, self.preset)
+        if not duties:
+            return
+        node = self.nodes.best()
+        t = types_for(self.preset)
+        state = node.chain.head_state
+        for d in duties:
+            pubkey = self._pubkey_for_index(d["validator_index"])
+            if pubkey is None:
+                continue
+            data = node.produce_attestation_data(slot, d["committee_index"])
+            try:
+                sig = self.store.sign_attestation(pubkey, data, state)
+            except (NotSafe, DoppelgangerHold):
+                continue
+            bits = tuple(
+                i == d["committee_position"]
+                for i in range(d["committee_length"])
+            )
+            node.publish_attestation(
+                t.Attestation(
+                    aggregation_bits=bits,
+                    data=data,
+                    signature=sig.to_bytes(),
+                )
+            )
+            self.attestations_published += 1
+
+    def _aggregation_duty(self, slot: int) -> None:
+        duties = self.duties.attestation_duties_at(slot, self.preset)
+        if not duties:
+            return
+        node = self.nodes.best()
+        t = types_for(self.preset)
+        state = node.chain.head_state
+        for d in duties:
+            pubkey = self._pubkey_for_index(d["validator_index"])
+            if pubkey is None:
+                continue
+            try:
+                proof = self.store.sign_selection_proof(pubkey, slot, state)
+            except DoppelgangerHold:
+                continue
+            if not is_aggregator(
+                d["committee_length"], proof.to_bytes(), self.spec
+            ):
+                continue
+            data = node.produce_attestation_data(slot, d["committee_index"])
+            aggregate = node.get_aggregate(data)
+            if aggregate is None:
+                continue
+            msg = t.AggregateAndProof(
+                aggregator_index=d["validator_index"],
+                aggregate=aggregate,
+                selection_proof=proof.to_bytes(),
+            )
+            try:
+                sig = self.store.sign_aggregate_and_proof(pubkey, msg, state)
+            except DoppelgangerHold:
+                continue
+            node.publish_aggregate_and_proof(
+                t.SignedAggregateAndProof(
+                    message=msg, signature=sig.to_bytes()
+                )
+            )
+            self.aggregates_published += 1
+
+    # -- doppelganger (doppelganger_service.rs:1-25) ------------------------
+
+    DOPPELGANGER_CLEAN_EPOCHS = 2
+
+    def _doppelganger_scan(self, epoch: int) -> None:
+        """Per held validator: record the epoch protection started, then
+        require DOPPELGANGER_CLEAN_EPOCHS fully-elapsed epochs with no
+        sighting of our index before releasing. A sighting is a detection
+        (the reference shuts the process down; we record and keep the
+        hold). If the node exposes no observed-attesters view, detection
+        is impossible: the timed release still runs so duties do not stall
+        forever (documented divergence)."""
+        node = self.nodes.best()
+        observed = getattr(node, "observed_attesters", None)
+        for pk in self.store.voting_pubkeys():
+            if not self.store._doppelganger_hold.get(pk):
+                continue
+            start = self._dg_start.setdefault(pk, epoch)
+            idx = self.store.validator_index(pk)
+            if observed is not None and idx is not None:
+                for e in range(max(start - 1, 0), epoch + 1):
+                    if observed.is_known(e, idx):
+                        if pk not in self.doppelganger_detected:
+                            self.doppelganger_detected.append(pk)
+                        break
+                else:
+                    if epoch >= start + self.DOPPELGANGER_CLEAN_EPOCHS:
+                        self.store.release_doppelganger(pk)
+            elif epoch >= start + self.DOPPELGANGER_CLEAN_EPOCHS:
+                self.store.release_doppelganger(pk)

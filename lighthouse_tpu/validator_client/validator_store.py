@@ -1,0 +1,137 @@
+"""ValidatorStore: the signing facade every duty service goes through
+(reference validator_client/src/validator_store.rs + signing_method.rs +
+initialized_validators.rs): key management, slashing-protection gating,
+and doppelganger holds."""
+
+from __future__ import annotations
+
+from ..crypto.bls import SecretKey, Signature
+from ..ssz import uint64
+from ..types import (
+    compute_epoch_at_slot,
+    compute_signing_root,
+    get_domain,
+)
+from ..types.chain_spec import (
+    DOMAIN_AGGREGATE_AND_PROOF,
+    DOMAIN_BEACON_ATTESTER,
+    DOMAIN_BEACON_PROPOSER,
+    DOMAIN_RANDAO,
+    DOMAIN_SELECTION_PROOF,
+)
+from ..types.containers import SigningData
+from ..types.presets import Preset
+from .slashing_protection import NotSafe, SlashingDatabase
+
+
+class DoppelgangerHold(RuntimeError):
+    """Signing refused: validator still in doppelganger observation."""
+
+
+class LocalKeystore:
+    """SigningMethod::LocalKeystore equivalent: in-memory secret key."""
+
+    def __init__(self, secret_key: SecretKey):
+        self.secret_key = secret_key
+        self.pubkey = secret_key.public_key()
+
+    def sign(self, signing_root: bytes) -> Signature:
+        return self.secret_key.sign(signing_root)
+
+
+class ValidatorStore:
+    def __init__(
+        self,
+        preset: Preset,
+        spec,
+        slashing_db: SlashingDatabase | None = None,
+    ):
+        self.preset = preset
+        self.spec = spec
+        self.slashing_db = slashing_db or SlashingDatabase()
+        self._methods: dict[bytes, LocalKeystore] = {}
+        self._index_by_pubkey: dict[bytes, int] = {}
+        self._doppelganger_hold: dict[bytes, bool] = {}
+
+    # -- key management (initialized_validators.rs) -------------------------
+
+    def add_validator(
+        self,
+        method: LocalKeystore,
+        validator_index: int | None = None,
+        doppelganger_protection: bool = False,
+    ) -> None:
+        pk = method.pubkey.to_bytes()
+        self._methods[pk] = method
+        if validator_index is not None:
+            self._index_by_pubkey[pk] = validator_index
+        self.slashing_db.register_validator(pk.hex())
+        self._doppelganger_hold[pk] = doppelganger_protection
+
+    def voting_pubkeys(self) -> list[bytes]:
+        return list(self._methods.keys())
+
+    def validator_index(self, pubkey: bytes) -> int | None:
+        return self._index_by_pubkey.get(bytes(pubkey))
+
+    def set_index(self, pubkey: bytes, index: int) -> None:
+        self._index_by_pubkey[bytes(pubkey)] = index
+
+    def release_doppelganger(self, pubkey: bytes) -> None:
+        self._doppelganger_hold[bytes(pubkey)] = False
+
+    def _method(self, pubkey: bytes) -> LocalKeystore:
+        m = self._methods.get(bytes(pubkey))
+        if m is None:
+            raise KeyError("unknown validator pubkey")
+        if self._doppelganger_hold.get(bytes(pubkey)):
+            raise DoppelgangerHold("validator held by doppelganger protection")
+        return m
+
+    # -- signing (validator_store.rs sign_*) --------------------------------
+
+    def sign_block(self, pubkey: bytes, block, state) -> Signature:
+        # resolve the method FIRST: a doppelganger hold must not burn the
+        # slot in the slashing DB for a signature that is never produced
+        method = self._method(pubkey)
+        epoch = compute_epoch_at_slot(block.slot, self.preset)
+        domain = get_domain(state, DOMAIN_BEACON_PROPOSER, epoch, self.preset)
+        root = compute_signing_root(block, domain)
+        self.slashing_db.check_and_insert_block_proposal(
+            bytes(pubkey).hex(), block.slot, root
+        )
+        return method.sign(root)
+
+    def sign_attestation(self, pubkey: bytes, data, state) -> Signature:
+        method = self._method(pubkey)
+        domain = get_domain(
+            state, DOMAIN_BEACON_ATTESTER, data.target.epoch, self.preset
+        )
+        root = compute_signing_root(data, domain)
+        self.slashing_db.check_and_insert_attestation(
+            bytes(pubkey).hex(), data.source.epoch, data.target.epoch, root
+        )
+        return method.sign(root)
+
+    def sign_randao(self, pubkey: bytes, epoch: int, state) -> Signature:
+        domain = get_domain(state, DOMAIN_RANDAO, epoch, self.preset)
+        root = SigningData(
+            object_root=uint64.hash_tree_root(epoch), domain=domain
+        ).tree_hash_root()
+        return self._method(pubkey).sign(root)
+
+    def sign_selection_proof(self, pubkey: bytes, slot: int, state) -> Signature:
+        epoch = compute_epoch_at_slot(slot, self.preset)
+        domain = get_domain(state, DOMAIN_SELECTION_PROOF, epoch, self.preset)
+        root = SigningData(
+            object_root=uint64.hash_tree_root(slot), domain=domain
+        ).tree_hash_root()
+        return self._method(pubkey).sign(root)
+
+    def sign_aggregate_and_proof(self, pubkey: bytes, msg, state) -> Signature:
+        epoch = compute_epoch_at_slot(msg.aggregate.data.slot, self.preset)
+        domain = get_domain(
+            state, DOMAIN_AGGREGATE_AND_PROOF, epoch, self.preset
+        )
+        root = compute_signing_root(msg, domain)
+        return self._method(pubkey).sign(root)

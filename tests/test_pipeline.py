@@ -1,0 +1,212 @@
+"""Batch pipeline tests: observed caches, naive aggregation, op pool
+max-cover packing, gossip attestation batch verification with fallback,
+and BeaconProcessor scheduling order (the coverage roles of reference
+beacon_chain/tests/attestation_verification.rs, op_pool tests, and
+network/src/beacon_processor/tests.rs)."""
+
+import pytest
+
+from lighthouse_tpu.chain.attestation_verification import (
+    batch_verify_aggregates,
+    batch_verify_unaggregated,
+)
+from lighthouse_tpu.crypto.bls import set_backend
+from lighthouse_tpu.harness import BeaconChainHarness
+from lighthouse_tpu.pool import (
+    NaiveAggregationPool,
+    ObservedAggregates,
+    ObservedAggregators,
+    ObservedAttesters,
+    ObservedBlockProducers,
+    OperationPool,
+)
+from lighthouse_tpu.processor import BeaconProcessor
+from lighthouse_tpu.state_transition import clone_state, process_slots
+from lighthouse_tpu.types import ChainSpec, MINIMAL
+
+
+@pytest.fixture(autouse=True)
+def fake_crypto():
+    set_backend("fake")
+    yield
+    set_backend("jax_tpu")
+
+
+def harness(n=64, sign=False):
+    return BeaconChainHarness(
+        n, MINIMAL, ChainSpec.interop(), sign=sign
+    )
+
+
+class TestObservedCaches:
+    def test_attesters_dedup_and_prune(self):
+        o = ObservedAttesters(retained_epochs=1)
+        assert not o.observe(5, 7)
+        assert o.observe(5, 7)
+        o.observe(8, 1)  # advances pruning window
+        assert not o.observe(8, 7)  # epoch 5 pruned, re-observable
+
+    def test_block_producers_equivocation(self):
+        o = ObservedBlockProducers()
+        assert o.observe(3, 1, b"a" * 32) is None
+        assert o.observe(3, 1, b"a" * 32) == "duplicate"
+        assert o.observe(3, 1, b"b" * 32) == "equivocation"
+
+
+class TestNaivePool:
+    def test_accumulates_single_bits(self):
+        h = harness()
+        h.extend_chain(2)
+        state = clone_state(h.chain.head_state)
+        state = process_slots(state, 3, MINIMAL, h.spec)
+        pool = NaiveAggregationPool()
+        committee_atts = [
+            h.producer.make_unaggregated(state, 2, 0, pos) for pos in range(2)
+        ]
+        for a in committee_atts:
+            assert pool.insert(a)
+        assert not pool.insert(committee_atts[0])  # duplicate attester
+        from lighthouse_tpu.types import types_for
+
+        agg = pool.get_aggregate(types_for(MINIMAL), committee_atts[0].data)
+        bits = list(agg.aggregation_bits)
+        assert bits[0] and bits[1]
+
+
+class TestOperationPool:
+    def test_max_cover_prefers_coverage(self):
+        h = harness()
+        h.extend_chain(3)
+        state = clone_state(h.chain.head_state)
+        adv = process_slots(clone_state(state), 4, MINIMAL, h.spec)
+        full = h.producer.attestations_for_slot(adv, 3)[0]
+        single = h.producer.make_unaggregated(adv, 3, 0, 0)
+        pool = OperationPool(MINIMAL, h.spec)
+        pool.insert_attestation(single)
+        pool.insert_attestation(full)
+        packed = pool.get_attestations(adv)
+        # the full aggregate covers the singleton: exactly one survives
+        assert len(packed) == 1
+        assert sum(packed[0].aggregation_bits) == sum(full.aggregation_bits)
+
+    def test_subset_aggregates_not_stored(self):
+        h = harness()
+        h.extend_chain(3)
+        adv = process_slots(
+            clone_state(h.chain.head_state), 4, MINIMAL, h.spec
+        )
+        full = h.producer.attestations_for_slot(adv, 3)[0]
+        single = h.producer.make_unaggregated(adv, 3, 0, 0)
+        pool = OperationPool(MINIMAL, h.spec)
+        pool.insert_attestation(full)
+        pool.insert_attestation(single)  # subset: dropped
+        assert pool.num_attestations() == 1
+
+
+class TestGossipVerification:
+    def test_unaggregated_batch_happy_path_and_dedup(self):
+        h = harness()
+        h.extend_chain(3)
+        chain = h.chain
+        state = process_slots(
+            clone_state(chain.head_state), 4, MINIMAL, h.spec
+        )
+        atts = [
+            h.producer.make_unaggregated(state, 3, 0, pos) for pos in range(2)
+        ]
+        observed = ObservedAttesters()
+        verified, rejected = batch_verify_unaggregated(
+            chain, atts + [atts[0]], observed
+        )
+        assert len(verified) == 2
+        assert len(rejected) == 1 and "already seen" in rejected[0][1]
+
+    def test_unaggregated_rejects_multi_bit_and_unknown_head(self):
+        h = harness()
+        h.extend_chain(3)
+        chain = h.chain
+        state = process_slots(
+            clone_state(chain.head_state), 4, MINIMAL, h.spec
+        )
+        good = h.producer.make_unaggregated(state, 3, 0, 0)
+        multi = h.producer.attestations_for_slot(state, 3)[0]  # all bits
+        unknown = h.producer.make_unaggregated(state, 3, 0, 1)
+        unknown.data.beacon_block_root = b"\x13" * 32
+        verified, rejected = batch_verify_unaggregated(
+            chain, [good, multi, unknown], ObservedAttesters()
+        )
+        assert len(verified) == 1
+        reasons = sorted(r for _, r in rejected)
+        assert any("one aggregation bit" in r for r in reasons)
+        assert any("unknown head" in r for r in reasons)
+
+    def test_aggregate_batch(self):
+        h = harness()
+        h.extend_chain(3)
+        chain = h.chain
+        state = process_slots(
+            clone_state(chain.head_state), 4, MINIMAL, h.spec
+        )
+        agg = h.producer.make_signed_aggregate(state, 3, 0)
+        verified, rejected = batch_verify_aggregates(
+            chain, [agg, agg], ObservedAggregates(), ObservedAggregators()
+        )
+        assert len(verified) == 1  # second is a duplicate
+        assert len(rejected) == 1
+
+    def test_batch_poisoning_falls_back_per_item(self):
+        set_backend("cpu")
+        h = harness(n=8, sign=True)
+        h.extend_chain(2)
+        chain = h.chain
+        state = process_slots(
+            clone_state(chain.head_state), 3, MINIMAL, h.spec
+        )
+        good = h.producer.make_unaggregated(state, 2, 0, 0)
+        bad = h.producer.make_unaggregated(state, 1, 0, 0)
+        bad.signature = good.signature  # wrong message for this signature
+        verified, rejected = batch_verify_unaggregated(
+            chain, [good, bad], ObservedAttesters()
+        )
+        assert len(verified) == 1
+        assert rejected and rejected[0][1] == "invalid signature"
+
+
+class TestBeaconProcessor:
+    def test_priority_order_and_batching(self):
+        journal = []
+        bp = BeaconProcessor(
+            handlers={
+                "gossip_block": lambda b: journal.append(("block", b)),
+                "gossip_aggregate": lambda xs: journal.append(
+                    ("aggs", len(xs))
+                ),
+                "gossip_attestation": lambda xs: journal.append(
+                    ("atts", len(xs))
+                ),
+            },
+            max_batch=64,
+        )
+        for i in range(100):
+            bp.submit("gossip_attestation", f"a{i}")
+        for i in range(3):
+            bp.submit("gossip_aggregate", f"g{i}")
+        bp.submit("gossip_block", "B")
+        bp.run_until_idle()
+        # block first, then aggregates (as one batch), then attestations in
+        # batches of <=64
+        assert journal[0] == ("block", "B")
+        assert journal[1] == ("aggs", 3)
+        assert journal[2] == ("atts", 64)
+        assert journal[3] == ("atts", 36)
+
+    def test_lifo_load_shedding(self):
+        bp = BeaconProcessor(handlers={}, max_batch=8)
+        q = bp.queues["gossip_attestation"]
+        q.max_len = 4
+        for i in range(6):
+            bp.submit("gossip_attestation", i)
+        assert len(q) == 4
+        assert q.dropped == 2
+        # newest survive (LIFO sheds oldest)
+        assert sorted(q.items) == [2, 3, 4, 5]

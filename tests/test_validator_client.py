@@ -1,0 +1,196 @@
+"""Validator-client tests: slashing protection (EIP-3076 cases +
+interchange), duties, full duty loop against an in-process BN, fallback,
+doppelganger (coverage roles of reference validator_client tests incl.
+slashing_protection/src/lib.rs test vectors)."""
+
+import pytest
+
+from lighthouse_tpu.crypto.bls import set_backend
+from lighthouse_tpu.harness import BeaconChainHarness
+from lighthouse_tpu.types import ChainSpec, MINIMAL, interop_secret_key
+from lighthouse_tpu.validator_client import (
+    BeaconNodeFallback,
+    InProcessBeaconNode,
+    LocalKeystore,
+    NoHealthyBeaconNode,
+    NotSafe,
+    SlashingDatabase,
+    ValidatorClient,
+    ValidatorStore,
+)
+
+PK = "ab" * 48
+
+
+@pytest.fixture(autouse=True)
+def fake_crypto():
+    set_backend("fake")
+    yield
+    set_backend("jax_tpu")
+
+
+class TestSlashingProtection:
+    def test_block_double_proposal_refused(self):
+        db = SlashingDatabase()
+        db.register_validator(PK)
+        db.check_and_insert_block_proposal(PK, 10, b"\x01" * 32)
+        db.check_and_insert_block_proposal(PK, 10, b"\x01" * 32)  # same root ok
+        with pytest.raises(NotSafe):
+            db.check_and_insert_block_proposal(PK, 10, b"\x02" * 32)
+        with pytest.raises(NotSafe):
+            db.check_and_insert_block_proposal(PK, 9, b"\x03" * 32)
+
+    def test_attestation_double_vote_refused(self):
+        db = SlashingDatabase()
+        db.register_validator(PK)
+        db.check_and_insert_attestation(PK, 1, 2, b"\x01" * 32)
+        db.check_and_insert_attestation(PK, 1, 2, b"\x01" * 32)  # idempotent
+        with pytest.raises(NotSafe):
+            db.check_and_insert_attestation(PK, 1, 2, b"\x02" * 32)
+
+    def test_surround_votes_refused(self):
+        db = SlashingDatabase()
+        db.register_validator(PK)
+        db.check_and_insert_attestation(PK, 2, 5, b"\x01" * 32)
+        with pytest.raises(NotSafe):  # surrounds (2,5)
+            db.check_and_insert_attestation(PK, 1, 6, b"\x02" * 32)
+        with pytest.raises(NotSafe):  # surrounded by (2,5)
+            db.check_and_insert_attestation(PK, 3, 4, b"\x03" * 32)
+
+    def test_unregistered_refused(self):
+        db = SlashingDatabase()
+        with pytest.raises(NotSafe):
+            db.check_and_insert_block_proposal(PK, 1, b"\x00" * 32)
+
+    def test_interchange_round_trip_blocks_imported_history(self):
+        db = SlashingDatabase()
+        db.register_validator(PK)
+        db.check_and_insert_attestation(PK, 2, 5, b"\x01" * 32)
+        db.check_and_insert_block_proposal(PK, 7, b"\x02" * 32)
+        payload = db.export_json(b"\x00" * 32)
+
+        db2 = SlashingDatabase()
+        db2.import_json(payload)
+        with pytest.raises(NotSafe):  # imported history enforced
+            db2.check_and_insert_attestation(PK, 1, 6, b"\x03" * 32)
+        with pytest.raises(NotSafe):
+            db2.check_and_insert_block_proposal(PK, 7, b"\x04" * 32)
+
+
+def make_vc(validators=16, register=4):
+    h = BeaconChainHarness(validators, MINIMAL, ChainSpec.interop())
+    node = InProcessBeaconNode(h.chain)
+    store = ValidatorStore(MINIMAL, h.spec)
+    for i in range(register):
+        store.add_validator(LocalKeystore(interop_secret_key(i)))
+    vc = ValidatorClient(
+        store, BeaconNodeFallback([node]), MINIMAL, h.spec
+    )
+    return h, node, vc
+
+
+class TestDuties:
+    def test_proposer_and_attester_duties(self):
+        h, node, vc = make_vc()
+        vc.duties.poll(0)
+        proposers = vc.duties.proposers[0]
+        assert len(proposers) == MINIMAL.slots_per_epoch
+        duties = vc.duties.attesters[0]
+        # each registered validator attests exactly once per epoch
+        assert sorted(d["validator_index"] for d in duties) == [0, 1, 2, 3]
+
+    def test_duty_committee_positions_consistent(self):
+        h, node, vc = make_vc()
+        vc.duties.poll(0)
+        from lighthouse_tpu.types import CommitteeCache
+
+        cache = CommitteeCache(h.chain.head_state, 0, MINIMAL, h.spec)
+        for d in vc.duties.attesters[0]:
+            committee = cache.get_beacon_committee(
+                d["slot"], d["committee_index"]
+            )
+            assert committee[d["committee_position"]] == d["validator_index"]
+
+
+class TestDutyLoop:
+    def test_attestations_blocks_aggregates_flow(self):
+        h, node, vc = make_vc(validators=16, register=16)
+        # walk several slots: VC proposes whenever one of our keys has the
+        # duty and attests per duty; BN packs pooled attestations
+        for slot in range(1, 2 * MINIMAL.slots_per_epoch + 1):
+            h.chain.slot_clock.set_slot(slot)
+            h.chain.on_tick()
+            vc.on_slot(slot)
+        assert vc.attestations_published > 0
+        assert vc.aggregates_published > 0
+        # with every validator registered, every slot should have produced
+        # a block through the VC
+        assert len(vc.blocks_proposed) == 2 * MINIMAL.slots_per_epoch
+        assert h.chain.head_state.slot == 2 * MINIMAL.slots_per_epoch
+        # packed attestations made it into blocks
+        total_packed = sum(
+            len(
+                h.store.get_block(r).message.body.attestations
+            )
+            for r in vc.blocks_proposed
+        )
+        assert total_packed > 0
+
+    def test_slashing_protection_blocks_equivocation(self):
+        h, node, vc = make_vc(validators=16, register=16)
+        h.chain.slot_clock.set_slot(1)
+        vc.on_slot(1)
+        assert len(vc.blocks_proposed) == 1
+        # signing a COMPETING block at the same slot must hit the slashing
+        # protection gate (double proposal, different root)
+        proposer = vc.duties.block_proposal_duty(1, MINIMAL)
+        pubkey = vc._pubkey_for_index(proposer)
+        competing, _ = h.producer.produce_block(1)  # built on genesis state
+        competing.proposer_index = proposer
+        competing.message.body.graffiti = b"\x42" * 32
+        with pytest.raises(NotSafe):
+            vc.store.sign_block(
+                pubkey, competing.message, h.chain.head_state
+            )
+
+
+class TestFallback:
+    def test_failover_to_second_node(self):
+        h, node, vc = make_vc()
+        h2 = BeaconChainHarness(16, MINIMAL, ChainSpec.interop())
+        node2 = InProcessBeaconNode(h2.chain)
+        vc.nodes = BeaconNodeFallback([node, node2])
+        node.healthy = False
+        assert vc.nodes.best() is node2
+        node2.healthy = False
+        with pytest.raises(NoHealthyBeaconNode):
+            vc.nodes.best()
+
+
+class TestDoppelganger:
+    def test_detection_and_release(self):
+        h, node, vc = make_vc(register=2)
+        from lighthouse_tpu.pool import ObservedAttesters
+
+        node.observed_attesters = ObservedAttesters()
+        for pk in vc.store.voting_pubkeys():
+            vc.store._doppelganger_hold[pk] = True
+        vc.duties.poll(0)
+        # index 0's attestation appears on the network -> detection
+        node.observed_attesters.observe(0, 0)
+        vc._doppelganger_scan(0)
+        pk0 = next(
+            pk
+            for pk in vc.store.voting_pubkeys()
+            if vc.store.validator_index(pk) == 0
+        )
+        assert pk0 in vc.doppelganger_detected
+        # the other key stays held until clean epochs elapse, then releases
+        pk1 = next(
+            pk
+            for pk in vc.store.voting_pubkeys()
+            if vc.store.validator_index(pk) == 1
+        )
+        assert vc.store._doppelganger_hold[pk1]
+        vc._doppelganger_scan(2)
+        assert not vc.store._doppelganger_hold[pk1]

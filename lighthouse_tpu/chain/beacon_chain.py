@@ -90,6 +90,12 @@ class BeaconChain:
         self.head_root = genesis_root
         self.head_state = clone_state(genesis_state)
         self._states: dict[bytes, object] = {genesis_root: genesis_state}
+        # SSE event subscribers (events.rs): fn(kind: str, payload: dict)
+        self.event_sinks: list = []
+
+    def emit(self, kind: str, payload: dict) -> None:
+        for sink in self.event_sinks:
+            sink(kind, payload)
 
     # -- time ----------------------------------------------------------------
 
@@ -155,7 +161,21 @@ class BeaconChain:
                 list(indexed.attesting_indices),
                 bytes(att.data.beacon_block_root),
             )
+        old_head = self.head_root
         self.recompute_head()
+        self.emit(
+            "block",
+            {"slot": block.slot, "block": "0x" + block_root.hex()},
+        )
+        if self.head_root != old_head:
+            self.emit(
+                "head",
+                {
+                    "slot": self.head_state.slot,
+                    "block": "0x" + self.head_root.hex(),
+                    "state": "0x" + state_root.hex(),
+                },
+            )
         self._prune_on_finality()
         return block_root
 

@@ -1,0 +1,282 @@
+"""Top-level CLI (reference lighthouse/src/main.rs:348-617 clap tree:
+`lighthouse {bn,vc,am,db}` + the lcli dev tools): argparse subcommands
+wiring the same component stacks the tests drive in-process.
+
+Entry: python -m lighthouse_tpu.cli <subcommand> ...
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+import time
+
+
+def _spec_preset(args):
+    from .types import ChainSpec, MAINNET, MINIMAL
+
+    preset = MINIMAL if args.preset == "minimal" else MAINNET
+    if args.network == "interop":
+        spec = ChainSpec.interop(
+            altair_fork_epoch=args.altair_fork_epoch
+        )
+    elif args.network == "minimal":
+        spec = ChainSpec.minimal()
+    else:
+        spec = ChainSpec.mainnet()
+    return preset, spec
+
+
+def _add_network_args(p):
+    p.add_argument("--network", default="interop",
+                   choices=["interop", "minimal", "mainnet"])
+    p.add_argument("--preset", default="minimal",
+                   choices=["minimal", "mainnet"])
+    p.add_argument("--altair-fork-epoch", type=int, default=None)
+
+
+# --- beacon node ------------------------------------------------------------
+
+
+def build_beacon_node(args):
+    """ClientBuilder equivalent (reference client/src/builder.rs:56):
+    store -> genesis -> chain -> pools -> API server."""
+    from .chain.beacon_chain import BeaconChain
+    from .http_api import BeaconApi, BeaconApiServer
+    from .store.hot_cold import HotColdDB
+    from .store.kv import FileStore, MemoryStore
+    from .types import interop_genesis_state
+    from .utils.slot_clock import SystemSlotClock
+    from .validator_client.beacon_node import InProcessBeaconNode
+
+    preset, spec = _spec_preset(args)
+    kv = FileStore(args.datadir) if args.datadir else MemoryStore()
+    store = HotColdDB(kv, preset, spec)
+    genesis = interop_genesis_state(
+        args.interop_validators, preset, spec,
+        genesis_time=args.genesis_time or int(time.time()),
+    )
+    clock = SystemSlotClock(genesis.genesis_time, spec.seconds_per_slot)
+    chain = BeaconChain(store, genesis, preset, spec, slot_clock=clock)
+    node = InProcessBeaconNode(chain)
+    api = BeaconApi(node)
+    server = BeaconApiServer(api, port=args.http_port)
+    return node, server
+
+
+def cmd_bn(args):
+    node, server = build_beacon_node(args)
+    server.start()
+    print(f"beacon node: http API on :{server.port}, "
+          f"{len(node.chain.head_state.validators)} validators")
+    if args.dry_run:
+        server.stop()
+        return 0
+    try:
+        while True:  # notifier loop (client/src/notifier.rs)
+            time.sleep(node.spec.seconds_per_slot)
+            node.chain.on_tick()
+            head = node.chain.head_state
+            print(f"slot {node.chain.current_slot} head {head.slot} "
+                  f"finalized {node.chain.finalized_checkpoint[0]}")
+    except KeyboardInterrupt:
+        server.stop()
+    return 0
+
+
+# --- validator client -------------------------------------------------------
+
+
+def cmd_vc(args):
+    from .http_api import BeaconNodeHttpClient
+    from .types import interop_secret_key
+    from .validator_client import (
+        BeaconNodeFallback, LocalKeystore, ValidatorClient, ValidatorStore,
+    )
+    from .crypto.keystore import Keystore
+
+    preset, spec = _spec_preset(args)
+    nodes = BeaconNodeFallback([
+        BeaconNodeHttpClient(url, preset) for url in args.beacon_nodes
+    ])
+    store = ValidatorStore(preset, spec)
+    count = 0
+    if args.interop_validators:
+        lo, _, hi = args.interop_validators.partition("..")
+        for i in range(int(lo), int(hi)):
+            store.add_validator(LocalKeystore(interop_secret_key(i)))
+            count += 1
+    for path in args.keystores or []:
+        with open(path) as f:
+            ks = Keystore.from_json(f.read())
+        store.add_validator(LocalKeystore(ks.decrypt(args.password or "")))
+        count += 1
+    vc = ValidatorClient(store, nodes, preset, spec)
+    print(f"validator client: {count} validators, "
+          f"{len(args.beacon_nodes)} beacon node(s)")
+    if args.dry_run:
+        return 0
+    last_slot = -1
+    try:
+        while True:
+            node = nodes.best()
+            slot = int(node.syncing()["head_slot"])
+            if slot != last_slot:
+                vc.on_slot(slot + 1)
+                last_slot = slot
+            time.sleep(1)
+    except KeyboardInterrupt:
+        pass
+    return 0
+
+
+# --- account manager --------------------------------------------------------
+
+
+def cmd_am(args):
+    from .crypto.keystore import Wallet, Keystore
+
+    if args.am_cmd == "wallet-create":
+        w = Wallet.create(args.name, args.password)
+        print(w.to_json())
+    elif args.am_cmd == "validator-create":
+        with open(args.wallet) as f:
+            w = Wallet.from_json(f.read())
+        ks = w.next_validator(args.password, args.keystore_password)
+        with open(args.wallet, "w") as f:
+            f.write(w.to_json())
+        print(ks.to_json())
+    elif args.am_cmd == "slashing-protection-export":
+        from .validator_client.slashing_protection import SlashingDatabase
+
+        db = SlashingDatabase(args.db)
+        print(db.export_json(bytes.fromhex(args.genesis_validators_root)))
+    elif args.am_cmd == "slashing-protection-import":
+        from .validator_client.slashing_protection import SlashingDatabase
+
+        db = SlashingDatabase(args.db)
+        db.import_json(
+            sys.stdin.read(),
+            bytes.fromhex(args.genesis_validators_root),
+        )
+        print("imported")
+    return 0
+
+
+# --- database manager (reference database_manager/src/lib.rs) --------------
+
+
+def cmd_db(args):
+    from .store.kv import Column, FileStore
+
+    kv = FileStore(args.datadir)
+    if args.db_cmd == "inspect":
+        for name in ("BLOCK", "STATE", "STATE_SUMMARY", "FREEZER_BLOCK"):
+            col = getattr(Column, name)
+            print(f"{name.lower()}: {len(kv.keys(col))} entries")
+    elif args.db_cmd == "version":
+        print("schema version 1")
+    return 0
+
+
+# --- dev tools (reference lcli/src/main.rs:54-610) -------------------------
+
+
+def cmd_tools(args):
+    preset, spec = _spec_preset(args)
+    if args.tool_cmd == "skip-slots":
+        from .state_transition import process_slots
+        from .types import interop_genesis_state
+
+        state = interop_genesis_state(args.validators, preset, spec)
+        t0 = time.time()
+        state = process_slots(state, args.slots, preset, spec)
+        print(json.dumps({
+            "slots": args.slots,
+            "state_root": "0x" + state.tree_hash_root().hex(),
+            "seconds": round(time.time() - t0, 3),
+        }))
+    elif args.tool_cmd == "transition-blocks":
+        # state-transition timing over a harness-built chain
+        from .crypto.bls import set_backend
+        from .harness import StateHarness
+
+        set_backend("fake")
+        h = StateHarness(args.validators, preset, spec, sign=False)
+        t0 = time.time()
+        h.extend_chain(args.slots)
+        print(json.dumps({
+            "blocks": args.slots,
+            "per_block_ms": round((time.time() - t0) / args.slots * 1e3, 2),
+        }))
+    elif args.tool_cmd == "pretty-ssz":
+        from .types import types_for, block_classes_for
+
+        t = types_for(preset)
+        _, signed_cls, _ = block_classes_for(t, args.fork)
+        with open(args.file, "rb") as f:
+            obj = signed_cls.from_ssz_bytes(f.read())
+        print(repr(obj))
+    return 0
+
+
+def main(argv=None) -> int:
+    parser = argparse.ArgumentParser(prog="lighthouse-tpu")
+    sub = parser.add_subparsers(dest="cmd", required=True)
+
+    bn = sub.add_parser("bn", help="run a beacon node")
+    _add_network_args(bn)
+    bn.add_argument("--datadir", default=None)
+    bn.add_argument("--http-port", type=int, default=0)
+    bn.add_argument("--interop-validators", type=int, default=64)
+    bn.add_argument("--genesis-time", type=int, default=None)
+    bn.add_argument("--dry-run", action="store_true")
+    bn.set_defaults(fn=cmd_bn)
+
+    vc = sub.add_parser("vc", help="run a validator client")
+    _add_network_args(vc)
+    vc.add_argument("--beacon-nodes", nargs="+",
+                    default=["http://127.0.0.1:5052"])
+    vc.add_argument("--interop-validators", default=None,
+                    help="range lo..hi of interop keys")
+    vc.add_argument("--keystores", nargs="*", default=None)
+    vc.add_argument("--password", default=None)
+    vc.add_argument("--dry-run", action="store_true")
+    vc.set_defaults(fn=cmd_vc)
+
+    am = sub.add_parser("am", help="account manager")
+    am.add_argument("am_cmd", choices=[
+        "wallet-create", "validator-create",
+        "slashing-protection-export", "slashing-protection-import",
+    ])
+    am.add_argument("--name", default="wallet")
+    am.add_argument("--password", default="")
+    am.add_argument("--keystore-password", default="")
+    am.add_argument("--wallet", default=None)
+    am.add_argument("--db", default=":memory:")
+    am.add_argument("--genesis-validators-root", default="00" * 32)
+    am.set_defaults(fn=cmd_am)
+
+    db = sub.add_parser("db", help="database manager")
+    db.add_argument("db_cmd", choices=["inspect", "version"])
+    db.add_argument("--datadir", required=True)
+    db.set_defaults(fn=cmd_db)
+
+    tools = sub.add_parser("tools", help="dev tools (lcli)")
+    _add_network_args(tools)
+    tools.add_argument("tool_cmd", choices=[
+        "skip-slots", "transition-blocks", "pretty-ssz",
+    ])
+    tools.add_argument("--validators", type=int, default=64)
+    tools.add_argument("--slots", type=int, default=8)
+    tools.add_argument("--fork", default="phase0")
+    tools.add_argument("--file", default=None)
+    tools.set_defaults(fn=cmd_tools)
+
+    args = parser.parse_args(argv)
+    return args.fn(args)
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -1,0 +1,9 @@
+"""Eth1 interface (reference beacon_node/eth1, SURVEY.md section 2.3):
+deposit tree/cache, block cache, eth1-data voting, mock provider."""
+
+from .deposit_tree import DEPOSIT_TREE_DEPTH, DepositDataTree  # noqa: F401
+from .service import (  # noqa: F401
+    Eth1Block,
+    Eth1Service,
+    MockEth1Provider,
+)

@@ -1,0 +1,91 @@
+"""Eth1 data service (reference beacon_node/eth1/src/service.rs:
+deposit-log polling into a DepositCache + BlockCache for eth1-data
+voting). The provider boundary is a duck type; MockEth1Provider plays the
+role of the reference's eth1 test rig (testing/eth1_test_rig)."""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+
+from ..types.containers import Eth1Data
+from .deposit_tree import DepositDataTree
+
+
+@dataclass
+class Eth1Block:
+    number: int
+    hash: bytes
+    timestamp: int
+    deposit_count: int
+
+
+class MockEth1Provider:
+    """In-process eth1 chain: injectable blocks + deposit logs."""
+
+    def __init__(self):
+        self.blocks: list[Eth1Block] = []
+        self.deposit_logs: list = []  # DepositData in log order
+
+    def add_block(self, timestamp: int, new_deposits=()) -> Eth1Block:
+        for d in new_deposits:
+            self.deposit_logs.append(d)
+        blk = Eth1Block(
+            number=len(self.blocks),
+            hash=bytes([len(self.blocks) % 256]) * 32,
+            timestamp=timestamp,
+            deposit_count=len(self.deposit_logs),
+        )
+        self.blocks.append(blk)
+        return blk
+
+    def get_blocks(self, from_number: int) -> list[Eth1Block]:
+        return self.blocks[from_number:]
+
+    def get_deposit_logs(self, from_index: int) -> list:
+        return self.deposit_logs[from_index:]
+
+
+class Eth1Service:
+    def __init__(self, provider, follow_distance: int = 4):
+        self.provider = provider
+        self.follow_distance = follow_distance
+        self.deposit_tree = DepositDataTree()
+        self.block_cache: list[Eth1Block] = []
+
+    # -- polling (service.rs update loop) -----------------------------------
+
+    def update(self) -> None:
+        for log in self.provider.get_deposit_logs(
+            len(self.deposit_tree.leaves)
+        ):
+            self.deposit_tree.push(log)
+        known = len(self.block_cache)
+        self.block_cache.extend(self.provider.get_blocks(known))
+
+    # -- eth1 data voting (eth1_data aggregation) ---------------------------
+
+    def eth1_data_for_block(self, state) -> Eth1Data:
+        """The eth1 vote: follow-distance block's snapshot; falls back to
+        the state's current eth1_data when the cache is too shallow."""
+        if len(self.block_cache) <= self.follow_distance:
+            return state.eth1_data
+        blk = self.block_cache[-1 - self.follow_distance]
+        return Eth1Data(
+            deposit_root=self.deposit_tree.root(blk.deposit_count),
+            deposit_count=blk.deposit_count,
+            block_hash=blk.hash,
+        )
+
+    def deposits_for_block(self, state, max_deposits: int) -> list:
+        """Deposits owed by the state (eth1_deposit_index..deposit_count),
+        proved against the state's eth1_data root."""
+        start = state.eth1_deposit_index
+        count = state.eth1_data.deposit_count
+        out = []
+        for i in range(start, min(count, start + max_deposits)):
+            out.append(self.deposit_tree.deposit(i, _data_at(self, i), count))
+        return out
+
+
+def _data_at(service: Eth1Service, index: int):
+    return service.provider.deposit_logs[index]

@@ -1,0 +1,274 @@
+"""Standard Beacon API handlers (reference beacon_node/http_api/src/
+lib.rs, 3476 lines of warp routes): transport-agnostic route functions
+over the in-process node, JSON-shaped per the eth2 API spec (0x-hex
+bytes, stringified integers). The HTTP adapter lives in server.py; the
+typed client in client.py (reference common/eth2)."""
+
+from __future__ import annotations
+
+from ..state_transition import clone_state
+from ..types import compute_epoch_at_slot, types_for
+from ..validator_client.beacon_node import InProcessBeaconNode
+
+API_VERSION = "lighthouse-tpu/0.1.0"
+
+
+def hexs(b: bytes) -> str:
+    return "0x" + bytes(b).hex()
+
+
+def unhex(s: str) -> bytes:
+    return bytes.fromhex(s.removeprefix("0x"))
+
+
+class ApiError(ValueError):
+    def __init__(self, status: int, message: str):
+        super().__init__(message)
+        self.status = status
+
+
+class BeaconApi:
+    """Route handlers; names mirror the eth2 API paths."""
+
+    def __init__(self, node: InProcessBeaconNode):
+        self.node = node
+        self.chain = node.chain
+        self.events: list = []  # (kind, payload) journal for SSE
+        self.chain.event_sinks.append(
+            lambda kind, payload: self.events.append((kind, payload))
+        )
+
+    # -- state resolution ----------------------------------------------------
+
+    def _state(self, state_id: str):
+        chain = self.chain
+        if state_id == "head":
+            return chain.head_state
+        if state_id == "genesis":
+            root = chain.store.get_chain_item(
+                b"block_post_state:" + chain.genesis_block_root
+            )
+            return chain.store.get_state(root)
+        if state_id == "finalized":
+            _, fin_root = chain.finalized_checkpoint
+            st = chain._states.get(fin_root)
+            if st is not None:
+                return st
+            return chain.head_state
+        if state_id.startswith("0x"):
+            return chain.store.get_state(unhex(state_id))
+        raise ApiError(400, f"unsupported state id {state_id}")
+
+    def _block_root(self, block_id: str) -> bytes:
+        if block_id == "head":
+            return self.chain.head_root
+        if block_id == "genesis":
+            return self.chain.genesis_block_root
+        if block_id.startswith("0x"):
+            return unhex(block_id)
+        raise ApiError(400, f"unsupported block id {block_id}")
+
+    # -- beacon namespace ----------------------------------------------------
+
+    def get_genesis(self) -> dict:
+        state = self._state("genesis")
+        return {
+            "data": {
+                "genesis_time": str(state.genesis_time),
+                "genesis_validators_root": hexs(
+                    state.genesis_validators_root
+                ),
+                "genesis_fork_version": hexs(
+                    self.chain.spec.genesis_fork_version
+                ),
+            }
+        }
+
+    def get_state_root(self, state_id: str) -> dict:
+        return {"data": {"root": hexs(self._state(state_id).tree_hash_root())}}
+
+    def get_fork(self, state_id: str) -> dict:
+        f = self._state(state_id).fork
+        return {
+            "data": {
+                "previous_version": hexs(f.previous_version),
+                "current_version": hexs(f.current_version),
+                "epoch": str(f.epoch),
+            }
+        }
+
+    def get_finality_checkpoints(self, state_id: str) -> dict:
+        s = self._state(state_id)
+        return {
+            "data": {
+                "previous_justified": {
+                    "epoch": str(s.previous_justified_checkpoint.epoch),
+                    "root": hexs(s.previous_justified_checkpoint.root),
+                },
+                "current_justified": {
+                    "epoch": str(s.current_justified_checkpoint.epoch),
+                    "root": hexs(s.current_justified_checkpoint.root),
+                },
+                "finalized": {
+                    "epoch": str(s.finalized_checkpoint.epoch),
+                    "root": hexs(s.finalized_checkpoint.root),
+                },
+            }
+        }
+
+    def get_validators(self, state_id: str) -> dict:
+        s = self._state(state_id)
+        epoch = compute_epoch_at_slot(s.slot, self.chain.preset)
+        out = []
+        for i, v in enumerate(s.validators):
+            if v.activation_epoch > epoch:
+                status = "pending"
+            elif epoch < v.exit_epoch:
+                status = "active_ongoing"
+            else:
+                status = "exited"
+            out.append(
+                {
+                    "index": str(i),
+                    "balance": str(s.balances[i]),
+                    "status": status,
+                    "validator": {
+                        "pubkey": hexs(v.pubkey),
+                        "effective_balance": str(v.effective_balance),
+                        "slashed": bool(v.slashed),
+                        "activation_epoch": str(v.activation_epoch),
+                        "exit_epoch": str(v.exit_epoch),
+                    },
+                }
+            )
+        return {"data": out}
+
+    def get_block(self, block_id: str) -> dict:
+        root = self._block_root(block_id)
+        blk = self.chain.store.get_block_any_temperature(root)
+        if blk is None:
+            raise ApiError(404, "block not found")
+        return {
+            "version": type(blk).fork_name,
+            "data": {"ssz": hexs(blk.as_ssz_bytes())},
+        }
+
+    def get_block_header(self, block_id: str) -> dict:
+        root = self._block_root(block_id)
+        blk = self.chain.store.get_block_any_temperature(root)
+        if blk is None:
+            raise ApiError(404, "block not found")
+        m = blk.message
+        return {
+            "data": {
+                "root": hexs(root),
+                "header": {
+                    "slot": str(m.slot),
+                    "proposer_index": str(m.proposer_index),
+                    "parent_root": hexs(m.parent_root),
+                    "state_root": hexs(m.state_root),
+                    "body_root": hexs(m.body.tree_hash_root()),
+                },
+            }
+        }
+
+    def post_block(self, ssz_hex: str, fork: str) -> dict:
+        from ..types import block_classes_for
+
+        t = types_for(self.chain.preset)
+        _, signed_cls, _ = block_classes_for(t, fork)
+        blk = signed_cls.from_ssz_bytes(unhex(ssz_hex))
+        root = self.node.publish_block(blk)
+        return {"data": {"root": hexs(root)}}
+
+    def post_pool_attestations(self, attestations_ssz: list[str]) -> dict:
+        t = types_for(self.chain.preset)
+        for ssz_hex in attestations_ssz:
+            att = t.Attestation.from_ssz_bytes(unhex(ssz_hex))
+            self.node.publish_attestation(att)
+        return {}
+
+    # -- validator namespace -------------------------------------------------
+
+    def get_proposer_duties(self, epoch: int) -> dict:
+        duties = self.node.get_proposer_duties(epoch)
+        state = self.chain.head_state
+        return {
+            "data": [
+                {
+                    "pubkey": hexs(state.validators[v].pubkey),
+                    "validator_index": str(v),
+                    "slot": str(slot),
+                }
+                for slot, v in duties
+            ]
+        }
+
+    def post_attester_duties(self, epoch: int, indices: list[int]) -> dict:
+        duties = self.node.get_attester_duties(epoch, indices)
+        state = self.chain.head_state
+        return {
+            "data": [
+                {
+                    "pubkey": hexs(
+                        state.validators[d["validator_index"]].pubkey
+                    ),
+                    "validator_index": str(d["validator_index"]),
+                    "slot": str(d["slot"]),
+                    "committee_index": str(d["committee_index"]),
+                    "committee_length": str(d["committee_length"]),
+                    "validator_committee_index": str(
+                        d["committee_position"]
+                    ),
+                    "committees_at_slot": str(d["committees_at_slot"]),
+                }
+                for d in duties
+            ]
+        }
+
+    def produce_block(self, slot: int, randao_reveal: str) -> dict:
+        block = self.node.produce_block(slot, unhex(randao_reveal))
+        return {
+            "version": type(block).fork_name,
+            "data": {"ssz": hexs(block.as_ssz_bytes())},
+        }
+
+    def attestation_data(self, slot: int, committee_index: int) -> dict:
+        data = self.node.produce_attestation_data(slot, committee_index)
+        return {"data": {"ssz": hexs(data.as_ssz_bytes())}}
+
+    def aggregate_attestation(self, data_ssz: str) -> dict:
+        from ..types.containers import AttestationData
+
+        data = AttestationData.from_ssz_bytes(unhex(data_ssz))
+        agg = self.node.get_aggregate(data)
+        if agg is None:
+            raise ApiError(404, "no matching aggregate")
+        return {"data": {"ssz": hexs(agg.as_ssz_bytes())}}
+
+    def post_aggregate_and_proofs(self, items_ssz: list[str]) -> dict:
+        t = types_for(self.chain.preset)
+        for ssz_hex in items_ssz:
+            self.node.publish_aggregate_and_proof(
+                t.SignedAggregateAndProof.from_ssz_bytes(unhex(ssz_hex))
+            )
+        return {}
+
+    # -- node namespace ------------------------------------------------------
+
+    def get_health(self) -> int:
+        return 200 if self.node.is_healthy() else 503
+
+    def get_version(self) -> dict:
+        return {"data": {"version": API_VERSION}}
+
+    def get_syncing(self) -> dict:
+        head = self.chain.head_state.slot
+        current = self.chain.current_slot
+        return {
+            "data": {
+                "head_slot": str(head),
+                "sync_distance": str(max(current - head, 0)),
+                "is_syncing": current > head + 1,
+            }
+        }

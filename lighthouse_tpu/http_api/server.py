@@ -1,0 +1,211 @@
+"""HTTP adapter for BeaconApi (reference http_api's warp server +
+http_metrics): stdlib ThreadingHTTPServer on an ephemeral port, JSON
+bodies, /eth/v1|v2 routing, Prometheus-style /metrics text, and an SSE
+/eth/v1/events stream fed by the chain's event sinks."""
+
+from __future__ import annotations
+
+import json
+import re
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+from .api import ApiError, BeaconApi
+
+
+class BeaconApiServer:
+    def __init__(self, api: BeaconApi, host: str = "127.0.0.1", port: int = 0):
+        self.api = api
+        outer = self
+
+        class Handler(BaseHTTPRequestHandler):
+            def log_message(self, *args):  # quiet
+                pass
+
+            def _send(self, status: int, payload, content_type="application/json"):
+                body = (
+                    json.dumps(payload).encode()
+                    if not isinstance(payload, (bytes, str))
+                    else (
+                        payload.encode()
+                        if isinstance(payload, str)
+                        else payload
+                    )
+                )
+                self.send_response(status)
+                self.send_header("Content-Type", content_type)
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+            def _body(self):
+                length = int(self.headers.get("Content-Length", "0"))
+                if not length:
+                    return None
+                return json.loads(self.rfile.read(length))
+
+            def do_GET(self):
+                try:
+                    self._route("GET")
+                except ApiError as e:
+                    self._send(e.status, {"message": str(e)})
+                except Exception as e:  # noqa: BLE001
+                    self._send(500, {"message": str(e)})
+
+            def do_POST(self):
+                try:
+                    self._route("POST")
+                except ApiError as e:
+                    self._send(e.status, {"message": str(e)})
+                except Exception as e:  # noqa: BLE001
+                    self._send(500, {"message": str(e)})
+
+            def _route(self, method: str):
+                api = outer.api
+                path, _, query = self.path.partition("?")
+                params = dict(
+                    p.split("=", 1) for p in query.split("&") if "=" in p
+                )
+
+                routes_get = [
+                    (r"^/eth/v1/beacon/genesis$", lambda m: api.get_genesis()),
+                    (
+                        r"^/eth/v1/beacon/states/([^/]+)/root$",
+                        lambda m: api.get_state_root(m.group(1)),
+                    ),
+                    (
+                        r"^/eth/v1/beacon/states/([^/]+)/finality_checkpoints$",
+                        lambda m: api.get_finality_checkpoints(m.group(1)),
+                    ),
+                    (
+                        r"^/eth/v1/beacon/states/([^/]+)/fork$",
+                        lambda m: api.get_fork(m.group(1)),
+                    ),
+                    (
+                        r"^/eth/v1/beacon/states/([^/]+)/validators$",
+                        lambda m: api.get_validators(m.group(1)),
+                    ),
+                    (
+                        r"^/eth/v2/beacon/blocks/([^/]+)$",
+                        lambda m: api.get_block(m.group(1)),
+                    ),
+                    (
+                        r"^/eth/v1/beacon/headers/([^/]+)$",
+                        lambda m: api.get_block_header(m.group(1)),
+                    ),
+                    (
+                        r"^/eth/v1/validator/duties/proposer/(\d+)$",
+                        lambda m: api.get_proposer_duties(int(m.group(1))),
+                    ),
+                    (
+                        r"^/eth/v2/validator/blocks/(\d+)$",
+                        lambda m: api.produce_block(
+                            int(m.group(1)), params["randao_reveal"]
+                        ),
+                    ),
+                    (
+                        r"^/eth/v1/validator/attestation_data$",
+                        lambda m: api.attestation_data(
+                            int(params["slot"]), int(params["committee_index"])
+                        ),
+                    ),
+                    (
+                        r"^/eth/v1/validator/aggregate_attestation$",
+                        lambda m: api.aggregate_attestation(
+                            params["attestation_data"]
+                        ),
+                    ),
+                    (r"^/eth/v1/node/version$", lambda m: api.get_version()),
+                    (r"^/eth/v1/node/syncing$", lambda m: api.get_syncing()),
+                ]
+                routes_post = [
+                    (
+                        r"^/eth/v1/beacon/blocks$",
+                        lambda m: api.post_block(
+                            self._body()["ssz"], self._body_fork()
+                        ),
+                    ),
+                    (
+                        r"^/eth/v1/beacon/pool/attestations$",
+                        lambda m: api.post_pool_attestations(self._body()),
+                    ),
+                    (
+                        r"^/eth/v1/validator/duties/attester/(\d+)$",
+                        lambda m: api.post_attester_duties(
+                            int(m.group(1)), [int(i) for i in self._body()]
+                        ),
+                    ),
+                    (
+                        r"^/eth/v1/validator/aggregate_and_proofs$",
+                        lambda m: api.post_aggregate_and_proofs(self._body()),
+                    ),
+                ]
+
+                if method == "GET" and path == "/eth/v1/node/health":
+                    self._send(api.get_health(), {})
+                    return
+                if method == "GET" and path == "/metrics":
+                    self._send(200, outer.metrics_text(), "text/plain")
+                    return
+                if method == "GET" and path == "/eth/v1/events":
+                    self._send(
+                        200,
+                        "".join(
+                            f"event: {k}\ndata: {json.dumps(p)}\n\n"
+                            for k, p in api.events
+                        ),
+                        "text/event-stream",
+                    )
+                    return
+
+                table = routes_get if method == "GET" else routes_post
+                self._cached_body = None
+                for pattern, handler in table:
+                    m = re.match(pattern, path)
+                    if m:
+                        self._send(200, handler(m))
+                        return
+                self._send(404, {"message": f"no route {method} {path}"})
+
+            def _body_fork(self):
+                body = self._body()
+                return body.get("version", "phase0") if body else "phase0"
+
+        # cache request body between the two lambda reads in post_block
+        orig_body = Handler._body
+
+        def _body_cached(handler_self):
+            if getattr(handler_self, "_cached", None) is None:
+                handler_self._cached = orig_body(handler_self)
+            return handler_self._cached
+
+        Handler._body = _body_cached
+
+        self.server = ThreadingHTTPServer((host, port), Handler)
+        self.port = self.server.server_address[1]
+        self._thread: threading.Thread | None = None
+
+    def metrics_text(self) -> str:
+        """Prometheus exposition (reference http_metrics/src/lib.rs:147 +
+        lighthouse_metrics globals)."""
+        chain = self.api.chain
+        lines = [
+            "# TYPE beacon_head_slot gauge",
+            f"beacon_head_slot {chain.head_state.slot}",
+            "# TYPE beacon_finalized_epoch gauge",
+            f"beacon_finalized_epoch {chain.finalized_checkpoint[0]}",
+            "# TYPE beacon_validator_count gauge",
+            f"beacon_validator_count {len(chain.head_state.validators)}",
+        ]
+        return "\n".join(lines) + "\n"
+
+    def start(self) -> None:
+        self._thread = threading.Thread(
+            target=self.server.serve_forever, daemon=True
+        )
+        self._thread.start()
+
+    def stop(self) -> None:
+        self.server.shutdown()
+        if self._thread:
+            self._thread.join()

@@ -1,0 +1,14 @@
+"""Networking (reference beacon_node/lighthouse_network +
+beacon_node/network, SURVEY.md section 2.3): gossip topics, req/resp
+protocols, router, sync, peer scoring -- over an in-process message bus
+(the simulator-style multi-node transport; a wire transport slots in
+behind the same API)."""
+
+from .message_bus import GossipMessage, MessageBus, topic_name  # noqa: F401
+from .node import (  # noqa: F401
+    BLOCKS_BY_RANGE,
+    BLOCKS_BY_ROOT,
+    STATUS_PROTOCOL,
+    NetworkNode,
+)
+from .simulator import Simulator  # noqa: F401

@@ -1,0 +1,76 @@
+"""In-process network fabric (the transport seat of reference
+beacon_node/lighthouse_network's libp2p stack, exercised the way the
+reference tests distribution: testing/simulator spawns N in-process nodes
+on one runtime, node_test_rig/src/lib.rs:32-60 -- not a real cluster).
+
+`MessageBus` provides gossipsub-shaped topics (fork-digest namespaced,
+types/topics.rs) with per-peer subscriptions and delivery journals, plus
+direct req/resp channels (the rpc/ protocols). A real libp2p wire backend
+can replace the bus behind the same Router-facing API; ICI/DCN enters only
+for intra-pod signature-batch sharding (SURVEY.md section 5)."""
+
+from __future__ import annotations
+
+from collections import defaultdict
+from dataclasses import dataclass, field
+
+
+def topic_name(kind: str, fork_digest: bytes, subnet: int | None = None) -> str:
+    """Gossip topic naming (reference types/topics.rs):
+    /eth2/<fork_digest>/<kind>[_<subnet>]/ssz_snappy."""
+    base = f"/eth2/{fork_digest.hex()}/{kind}"
+    if subnet is not None:
+        base += f"_{subnet}"
+    return base + "/ssz_snappy"
+
+
+@dataclass
+class GossipMessage:
+    topic: str
+    payload: object
+    source_peer: str
+
+
+class MessageBus:
+    """Broadcast plane + req/resp plane for in-process multi-node tests."""
+
+    def __init__(self):
+        self._subs: dict[str, dict[str, object]] = defaultdict(dict)
+        self._rpc_handlers: dict[str, dict[str, object]] = defaultdict(dict)
+        self.published: list[GossipMessage] = []
+
+    # -- gossip --------------------------------------------------------------
+
+    def subscribe(self, peer_id: str, topic: str, handler) -> None:
+        self._subs[topic][peer_id] = handler
+
+    def unsubscribe(self, peer_id: str, topic: str) -> None:
+        self._subs[topic].pop(peer_id, None)
+
+    def publish(self, source_peer: str, topic: str, payload) -> int:
+        """Deliver to every subscriber except the source; returns the
+        delivery count (gossipsub loopback exclusion)."""
+        self.published.append(GossipMessage(topic, payload, source_peer))
+        delivered = 0
+        for peer_id, handler in list(self._subs.get(topic, {}).items()):
+            if peer_id == source_peer:
+                continue
+            handler(payload, source_peer)
+            delivered += 1
+        return delivered
+
+    # -- req/resp (rpc/) -----------------------------------------------------
+
+    def register_rpc(self, peer_id: str, protocol: str, handler) -> None:
+        self._rpc_handlers[protocol][peer_id] = handler
+
+    def request(self, from_peer: str, to_peer: str, protocol: str, payload):
+        handler = self._rpc_handlers.get(protocol, {}).get(to_peer)
+        if handler is None:
+            raise ConnectionError(
+                f"peer {to_peer} does not speak {protocol}"
+            )
+        return handler(payload, from_peer)
+
+    def peers_on(self, topic: str) -> list[str]:
+        return list(self._subs.get(topic, {}).keys())

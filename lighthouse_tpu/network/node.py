@@ -1,0 +1,236 @@
+"""Network node: Router + gossip methods + sync over the message bus
+(reference beacon_node/network/src/router/mod.rs:206 handle_gossip,
+worker/gossip_methods.rs, sync/manager.rs + range_sync, and
+lighthouse_network's peer manager scoring, peer_manager/peerdb/score.rs).
+
+One NetworkNode owns a BeaconChain, pools, observed caches, a
+BeaconProcessor, and a peer score table; it subscribes to the gossip
+topics and serves the req/resp protocols."""
+
+from __future__ import annotations
+
+from ..chain.attestation_verification import (
+    batch_verify_aggregates,
+    batch_verify_unaggregated,
+)
+from ..chain.beacon_chain import BeaconChain, BlockError
+from ..pool import (
+    NaiveAggregationPool,
+    ObservedAggregates,
+    ObservedAggregators,
+    ObservedAttesters,
+    ObservedBlockProducers,
+    OperationPool,
+)
+from ..processor import BeaconProcessor
+from ..types import compute_epoch_at_slot, compute_fork_digest
+from .message_bus import MessageBus, topic_name
+
+GOSSIP_PENALTY = -10
+BAN_THRESHOLD = -50
+
+STATUS_PROTOCOL = "/eth2/beacon_chain/req/status/1"
+BLOCKS_BY_RANGE = "/eth2/beacon_chain/req/beacon_blocks_by_range/1"
+BLOCKS_BY_ROOT = "/eth2/beacon_chain/req/beacon_blocks_by_root/1"
+
+
+class NetworkNode:
+    def __init__(self, peer_id: str, chain: BeaconChain, bus: MessageBus):
+        self.peer_id = peer_id
+        self.chain = chain
+        self.bus = bus
+        self.op_pool = OperationPool(chain.preset, chain.spec)
+        self.naive_pool = NaiveAggregationPool()
+        self.observed_attesters = ObservedAttesters()
+        self.observed_aggregates = ObservedAggregates()
+        self.observed_aggregators = ObservedAggregators()
+        self.observed_block_producers = ObservedBlockProducers()
+        self.peer_scores: dict[str, int] = {}
+        self.processor = BeaconProcessor(
+            handlers={
+                "gossip_block": self._work_block,
+                "gossip_aggregate": self._work_aggregates,
+                "gossip_attestation": self._work_attestations,
+            }
+        )
+
+        state = chain.head_state
+        self.fork_digest = compute_fork_digest(
+            bytes(state.fork.current_version),
+            bytes(state.genesis_validators_root),
+        )
+        self._topic_block = topic_name("beacon_block", self.fork_digest)
+        self._topic_aggregate = topic_name(
+            "beacon_aggregate_and_proof", self.fork_digest
+        )
+        bus.subscribe(peer_id, self._topic_block, self._on_gossip_block)
+        bus.subscribe(peer_id, self._topic_aggregate, self._on_gossip_aggregate)
+        for subnet in range(chain.spec.attestation_subnet_count):
+            bus.subscribe(
+                peer_id,
+                topic_name("beacon_attestation", self.fork_digest, subnet),
+                self._on_gossip_attestation,
+            )
+        bus.register_rpc(peer_id, STATUS_PROTOCOL, self._rpc_status)
+        bus.register_rpc(peer_id, BLOCKS_BY_RANGE, self._rpc_blocks_by_range)
+        bus.register_rpc(peer_id, BLOCKS_BY_ROOT, self._rpc_blocks_by_root)
+
+    # -- scoring (peerdb/score.rs) ------------------------------------------
+
+    def penalize(self, peer: str, amount: int = GOSSIP_PENALTY) -> None:
+        self.peer_scores[peer] = self.peer_scores.get(peer, 0) + amount
+
+    def is_banned(self, peer: str) -> bool:
+        return self.peer_scores.get(peer, 0) <= BAN_THRESHOLD
+
+    # -- gossip ingress (router -> processor queues) ------------------------
+
+    def _on_gossip_block(self, signed_block, source: str) -> None:
+        if self.is_banned(source):
+            return
+        block = signed_block.message
+        verdict = self.observed_block_producers.observe(
+            block.slot, block.proposer_index, block.tree_hash_root()
+        )
+        if verdict == "duplicate":
+            return
+        self.processor.submit("gossip_block", (signed_block, source))
+
+    def _on_gossip_aggregate(self, signed_aggregate, source: str) -> None:
+        if not self.is_banned(source):
+            self.processor.submit("gossip_aggregate", (signed_aggregate, source))
+
+    def _on_gossip_attestation(self, attestation, source: str) -> None:
+        if not self.is_banned(source):
+            self.processor.submit("gossip_attestation", (attestation, source))
+
+    # -- workers (worker/gossip_methods.rs) ---------------------------------
+
+    def _work_block(self, item) -> None:
+        signed_block, source = item
+        try:
+            self.chain.process_block(signed_block)
+        except BlockError:
+            self.penalize(source)
+            return
+        # mesh re-publication happens at the bus; nothing further here
+
+    def _work_aggregates(self, items) -> None:
+        aggs = [a for a, _ in items]
+        sources = {id(a): s for a, s in items}
+        verified, rejected = batch_verify_aggregates(
+            self.chain,
+            aggs,
+            self.observed_aggregates,
+            self.observed_aggregators,
+        )
+        for v in verified:
+            self.op_pool.insert_attestation(v.signed_aggregate.message.aggregate)
+            self.chain.apply_attestation(
+                v.signed_aggregate.message.aggregate, v.indexed_indices
+            )
+        for agg, reason in rejected:
+            if "signature" in reason or "selection" in reason:
+                self.penalize(sources.get(id(agg), ""))
+
+    def _work_attestations(self, items) -> None:
+        atts = [a for a, _ in items]
+        sources = {id(a): s for a, s in items}
+        verified, rejected = batch_verify_unaggregated(
+            self.chain, atts, self.observed_attesters
+        )
+        for v in verified:
+            self.naive_pool.insert(v.attestation)
+            self.op_pool.insert_attestation(v.attestation)
+            self.chain.apply_attestation(v.attestation, v.indexed_indices)
+        for att, reason in rejected:
+            if "signature" in reason:
+                self.penalize(sources.get(id(att), ""))
+
+    # -- publish (the local node's own messages) ----------------------------
+
+    def publish_block(self, signed_block) -> None:
+        self.chain.process_block(signed_block)
+        self.bus.publish(self.peer_id, self._topic_block, signed_block)
+
+    def publish_attestation(self, attestation, subnet: int = 0) -> None:
+        self.naive_pool.insert(attestation)
+        self.op_pool.insert_attestation(attestation)
+        self.bus.publish(
+            self.peer_id,
+            topic_name("beacon_attestation", self.fork_digest, subnet),
+            attestation,
+        )
+
+    def publish_aggregate(self, signed_aggregate) -> None:
+        self.op_pool.insert_attestation(signed_aggregate.message.aggregate)
+        self.bus.publish(self.peer_id, self._topic_aggregate, signed_aggregate)
+
+    # -- req/resp handlers (rpc/protocol.rs) --------------------------------
+
+    def _rpc_status(self, _payload, _peer):
+        head_root, head_state = self.chain.head()
+        return {
+            "fork_digest": self.fork_digest,
+            "finalized_epoch": self.chain.finalized_checkpoint[0],
+            "finalized_root": self.chain.finalized_checkpoint[1],
+            "head_root": head_root,
+            "head_slot": head_state.slot,
+        }
+
+    def _rpc_blocks_by_range(self, payload, _peer):
+        start, count = payload["start_slot"], payload["count"]
+        out = []
+        # walk the canonical chain from head backwards
+        root = self.chain.head_root
+        chain = []
+        while root in self.chain._states:
+            blk = self.chain.store.get_block_any_temperature(root)
+            if blk is None:
+                break
+            chain.append(blk)
+            root = bytes(blk.message.parent_root)
+        for blk in reversed(chain):
+            if start <= blk.message.slot < start + count:
+                out.append(blk)
+        return out
+
+    def _rpc_blocks_by_root(self, payload, _peer):
+        out = []
+        for root in payload["roots"]:
+            blk = self.chain.store.get_block_any_temperature(root)
+            if blk is not None:
+                out.append(blk)
+        return out
+
+    # -- sync (sync/manager.rs + range_sync) --------------------------------
+
+    def sync_with(self, peer: str) -> int:
+        """Range-sync from `peer` until our head reaches theirs; returns
+        blocks imported (the reference's forward range sync)."""
+        status = self.bus.request(self.peer_id, peer, STATUS_PROTOCOL, {})
+        imported = 0
+        while self.chain.head_state.slot < status["head_slot"]:
+            start = self.chain.head_state.slot + 1
+            blocks = self.bus.request(
+                self.peer_id,
+                peer,
+                BLOCKS_BY_RANGE,
+                {"start_slot": start, "count": 32},
+            )
+            if not blocks:
+                break
+            progressed = False
+            for blk in blocks:
+                try:
+                    self.chain.slot_clock.set_slot(
+                        max(self.chain.current_slot, blk.message.slot)
+                    )
+                    self.chain.process_block(blk)
+                    imported += 1
+                    progressed = True
+                except BlockError:
+                    continue
+            if not progressed:
+                break
+        return imported

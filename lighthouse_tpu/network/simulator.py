@@ -1,0 +1,93 @@
+"""Multi-node in-process simulator (reference testing/simulator/src/main.rs
++ checks.rs + node_test_rig: N beacon nodes + validator shares on one
+runtime, liveness/finality invariants asserted as slots progress)."""
+
+from __future__ import annotations
+
+from ..harness.chain import StateHarness
+from ..chain.beacon_chain import BeaconChain
+from ..store.hot_cold import HotColdDB
+from ..store.kv import MemoryStore
+from ..types import ChainSpec, compute_epoch_at_slot, interop_genesis_state
+from ..types.presets import Preset
+from .message_bus import MessageBus
+from .node import NetworkNode
+
+
+class Simulator:
+    def __init__(
+        self,
+        node_count: int,
+        validator_count: int,
+        preset: Preset,
+        spec: ChainSpec | None = None,
+    ):
+        self.preset = preset
+        self.spec = spec or ChainSpec.interop()
+        self.bus = MessageBus()
+        self.producer = StateHarness(
+            validator_count, preset, self.spec, sign=False
+        )
+        genesis = self.producer.state
+        self.nodes: list[NetworkNode] = []
+        for i in range(node_count):
+            from ..state_transition import clone_state
+
+            store = HotColdDB(MemoryStore(), preset, self.spec)
+            chain = BeaconChain(store, clone_state(genesis), preset, self.spec)
+            self.nodes.append(NetworkNode(f"node{i}", chain, self.bus))
+        # validator shares: validator v is driven through node v % N
+        self.validator_count = validator_count
+
+    def tick(self, slot: int) -> None:
+        for n in self.nodes:
+            n.chain.slot_clock.set_slot(slot)
+            n.chain.on_tick()
+
+    def run_slot(self, slot: int, attest: bool = True) -> None:
+        """One slot of the synthetic network: the proposer's node produces
+        and gossips a block; every node's processor drains; attestations
+        for the previous slot ride the subnets."""
+        self.tick(slot)
+        proposer_node = self.nodes[slot % len(self.nodes)]
+        parent_state = proposer_node.chain._states[
+            proposer_node.chain.head_root
+        ]
+        atts = []
+        if attest and slot > 1:
+            from ..state_transition import clone_state, process_slots
+
+            adv = process_slots(
+                clone_state(parent_state), slot, self.preset, self.spec
+            )
+            atts = self.producer.attestations_for_slot(adv, slot - 1)
+        signed, _ = self.producer.produce_block(
+            slot, atts, base_state=parent_state
+        )
+        proposer_node.publish_block(signed)
+        self.drain()
+
+    def drain(self) -> None:
+        for n in self.nodes:
+            n.processor.run_until_idle()
+
+    def run_epochs(self, epochs: int, attest: bool = True) -> None:
+        start = (
+            max(n.chain.head_state.slot for n in self.nodes) + 1
+        )
+        for slot in range(start, start + epochs * self.preset.slots_per_epoch):
+            self.run_slot(slot, attest=attest)
+
+    # -- checks (testing/simulator/src/checks.rs) ---------------------------
+
+    def check_all_heads_equal(self) -> bytes:
+        heads = {n.chain.head_root for n in self.nodes}
+        assert len(heads) == 1, f"nodes diverged: {len(heads)} heads"
+        return heads.pop()
+
+    def check_finality(self, min_epoch: int) -> None:
+        for n in self.nodes:
+            assert n.chain.finalized_checkpoint[0] >= min_epoch, (
+                f"{n.peer_id} finalized {n.chain.finalized_checkpoint[0]}"
+                f" < {min_epoch}"
+            )

@@ -1,0 +1,5 @@
+"""Slasher (reference slasher/ + slasher/service, SURVEY.md section 2.4):
+batched double-vote/surround/double-proposal detection feeding the
+operation pool."""
+
+from .slasher import Slasher  # noqa: F401

@@ -1,0 +1,160 @@
+"""Slasher: detects OTHER validators' slashable messages (reference
+slasher/src/: attestation/block queues batched per update (slasher.rs),
+min/max-target arrays for surround detection (array.rs:22-32), double
+vote and double proposal records (database.rs)).
+
+The reference keeps 16x256-chunked epoch arrays in LMDB; here the arrays
+are numpy windows over (validator, epoch) -- vectorized batch updates on
+host, persistence via the store abstraction later. Detection rules:
+
+  double vote:  same (validator, target epoch), different attestation root
+  surrounds:    new (s, t) with an existing (s', t'): s < s' and t' < t
+                 <=> min_target[v][s+1..] < t
+  surrounded:   exists (s', t') with s' < s and t' > t
+                 <=> max_target[v][..s-1] > t
+  double block: same (proposer, slot), different block root
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from ..types.presets import Preset
+
+_NO_TARGET_MIN = np.iinfo(np.int64).max
+_NO_TARGET_MAX = -1
+
+
+class Slasher:
+    def __init__(
+        self,
+        preset: Preset,
+        spec,
+        validator_capacity: int = 1 << 14,
+        history_epochs: int = 4096,
+    ):
+        self.preset = preset
+        self.spec = spec
+        self.history = history_epochs
+        # min_target[v][s]: min target among recorded atts with source >= s
+        self.min_target = np.full(
+            (validator_capacity, history_epochs), _NO_TARGET_MIN, np.int64
+        )
+        # max_target[v][s]: max target among recorded atts with source <= s
+        self.max_target = np.full(
+            (validator_capacity, history_epochs), _NO_TARGET_MAX, np.int64
+        )
+        # (validator, target_epoch) -> (att_root, indexed_attestation)
+        self.attestation_records: dict[tuple[int, int], tuple[bytes, object]] = {}
+        # (proposer, slot) -> signed_header
+        self.block_records: dict[tuple[int, int], object] = {}
+        self.attestation_queue: list = []
+        self.block_queue: list = []
+        self.attester_slashings: list = []
+        self.proposer_slashings: list = []
+
+    # -- ingestion (slasher.rs accept_*) ------------------------------------
+
+    def accept_attestation(self, indexed_attestation) -> None:
+        self.attestation_queue.append(indexed_attestation)
+
+    def accept_block_header(self, signed_header) -> None:
+        self.block_queue.append(signed_header)
+
+    # -- batched update (slasher.rs process_queued) -------------------------
+
+    def process_queued(self) -> tuple[list, list]:
+        """Drain queues, detect, record. Returns (new attester slashings,
+        new proposer slashings)."""
+        new_att, new_prop = [], []
+        for att in self.attestation_queue:
+            new_att.extend(self._process_attestation(att))
+        for header in self.block_queue:
+            s = self._process_block_header(header)
+            if s is not None:
+                new_prop.append(s)
+        self.attestation_queue.clear()
+        self.block_queue.clear()
+        self.attester_slashings.extend(new_att)
+        self.proposer_slashings.extend(new_prop)
+        return new_att, new_prop
+
+    # -- attestation detection ----------------------------------------------
+
+    def _grow(self, validator: int) -> None:
+        while validator >= self.min_target.shape[0]:
+            self.min_target = np.concatenate(
+                [self.min_target, np.full_like(self.min_target, _NO_TARGET_MIN)]
+            )
+            self.max_target = np.concatenate(
+                [self.max_target, np.full_like(self.max_target, _NO_TARGET_MAX)]
+            )
+
+    def _process_attestation(self, indexed) -> list:
+        out = []
+        data = indexed.data
+        s, t = data.source.epoch, data.target.epoch
+        if s >= self.history or t >= self.history:
+            return out  # outside the tracked window
+        att_root = data.tree_hash_root()
+        for v in indexed.attesting_indices:
+            self._grow(v)
+            # double vote
+            prior = self.attestation_records.get((v, t))
+            if prior is not None and prior[0] != att_root:
+                out.append((v, prior[1], indexed, "double"))
+                continue
+            # surround checks via the running arrays
+            if s + 1 < self.history and self.min_target[v, s + 1] < t:
+                culprit = self._find_record(v, lambda pt: pt[1] < t and pt[0] > s)
+                if culprit is not None:
+                    out.append((v, culprit, indexed, "surrounds"))
+            if s >= 1 and self.max_target[v, s - 1] > t:
+                culprit = self._find_record(v, lambda pt: pt[1] > t and pt[0] < s)
+                if culprit is not None:
+                    out.append((v, culprit, indexed, "surrounded"))
+            # record
+            self.attestation_records[(v, t)] = (att_root, indexed)
+            # min_target[s'] for s' <= s gets min'ed with t
+            seg = self.min_target[v, : s + 1]
+            np.minimum(seg, t, out=seg)
+            # max_target[s'] for s' >= s gets max'ed with t
+            seg = self.max_target[v, s:]
+            np.maximum(seg, t, out=seg)
+        return self._to_attester_slashings(out)
+
+    def _find_record(self, validator: int, predicate):
+        for (v, t), (_, indexed) in self.attestation_records.items():
+            if v == validator and predicate(
+                (indexed.data.source.epoch, indexed.data.target.epoch)
+            ):
+                return indexed
+        return None
+
+    def _to_attester_slashings(self, detections) -> list:
+        from ..types import types_for
+
+        t = types_for(self.preset)
+        out = []
+        for _, prior, new, _kind in detections:
+            out.append(
+                t.AttesterSlashing(attestation_1=prior, attestation_2=new)
+            )
+        return out
+
+    # -- block detection -----------------------------------------------------
+
+    def _process_block_header(self, signed_header):
+        header = signed_header.message
+        key = (header.proposer_index, header.slot)
+        prior = self.block_records.get(key)
+        if prior is None:
+            self.block_records[key] = signed_header
+            return None
+        if prior.message.tree_hash_root() == header.tree_hash_root():
+            return None
+        from ..types.containers import ProposerSlashing
+
+        return ProposerSlashing(
+            signed_header_1=prior, signed_header_2=signed_header
+        )

@@ -50,6 +50,22 @@ class InProcessBeaconNode:
     def head_slot(self) -> int:
         return self.chain.head_state.slot
 
+    def signing_context(self):
+        """Object carrying fork + genesis_validators_root + slot for
+        domain computation (the head state serves directly in-process;
+        the HTTP client synthesizes an equivalent shim)."""
+        return self.chain.head_state
+
+    def validator_index_map(self, pubkeys) -> dict:
+        """pubkey bytes -> validator index for the requested keys."""
+        state = self.chain.head_state
+        wanted = set(bytes(p) for p in pubkeys)
+        return {
+            bytes(v.pubkey): i
+            for i, v in enumerate(state.validators)
+            if bytes(v.pubkey) in wanted
+        }
+
     # -- duties (the endpoints duties_service.rs:356-765 polls) -------------
 
     def get_proposer_duties(self, epoch: int) -> list[tuple[int, int]]:

@@ -71,15 +71,14 @@ class DutiesService:
         lookahead) if not already known."""
         node = self.nodes.best()
         # resolve unknown validator indices first (poll_validator_indices)
-        state = node.chain.head_state
-        pubkey_to_index = {
-            bytes(v.pubkey): i for i, v in enumerate(state.validators)
-        }
-        for pk in self.store.voting_pubkeys():
-            if self.store.validator_index(pk) is None:
-                idx = pubkey_to_index.get(pk)
-                if idx is not None:
-                    self.store.set_index(pk, idx)
+        unknown = [
+            pk
+            for pk in self.store.voting_pubkeys()
+            if self.store.validator_index(pk) is None
+        ]
+        if unknown:
+            for pk, idx in node.validator_index_map(unknown).items():
+                self.store.set_index(pk, idx)
         for e in (epoch, epoch + 1):
             if e in self._polled:
                 continue
@@ -149,7 +148,7 @@ class ValidatorClient:
             return
         pubkey = self._pubkey_for_index(proposer)
         node = self.nodes.best()
-        state = node.chain.head_state
+        state = node.signing_context()
         epoch = compute_epoch_at_slot(slot, self.preset)
         try:
             randao = self.store.sign_randao(pubkey, epoch, state)
@@ -172,7 +171,7 @@ class ValidatorClient:
             return
         node = self.nodes.best()
         t = types_for(self.preset)
-        state = node.chain.head_state
+        state = node.signing_context()
         for d in duties:
             pubkey = self._pubkey_for_index(d["validator_index"])
             if pubkey is None:
@@ -201,7 +200,7 @@ class ValidatorClient:
             return
         node = self.nodes.best()
         t = types_for(self.preset)
-        state = node.chain.head_state
+        state = node.signing_context()
         for d in duties:
             pubkey = self._pubkey_for_index(d["validator_index"])
             if pubkey is None:

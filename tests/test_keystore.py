@@ -1,0 +1,92 @@
+"""Keystore/derivation/wallet tests with published spec vectors:
+EIP-2333 test case 0 and the EIP-2335 scrypt/pbkdf2 round trip semantics
+(reference crypto/eth2_keystore + eth2_key_derivation test suites)."""
+
+import json
+
+import pytest
+
+from lighthouse_tpu.crypto.bls import SecretKey
+from lighthouse_tpu.crypto.keystore import (
+    Keystore,
+    KeystoreError,
+    Wallet,
+    derive_child_sk,
+    derive_master_sk,
+    derive_path,
+    validator_path,
+)
+
+
+class TestEip2333:
+    # EIP-2333 published test case 0
+    SEED = bytes.fromhex(
+        "c55257c360c07c72029aebc1b53c05ed0362ada38ead3e3e9efa3708e5349553"
+        "1f09a6987599d18264c1e1c92f2cf141630c7a3c4ab7c81b2f001698e7463b04"
+    )
+    MASTER = 6083874454709270928345386274498605044986640685124978867557563392430687146096
+    CHILD0 = 20397789859736650942317412262472558107875392172444076792671091975210932703118
+
+    def test_master_vector(self):
+        assert derive_master_sk(self.SEED) == self.MASTER
+
+    def test_child_vector(self):
+        assert derive_child_sk(self.MASTER, 0) == self.CHILD0
+
+    def test_path_equivalence(self):
+        via_path = derive_path(self.SEED, "m/0")
+        assert via_path == self.CHILD0
+
+    def test_short_seed_rejected(self):
+        with pytest.raises(KeystoreError):
+            derive_master_sk(b"short")
+
+    def test_validator_paths(self):
+        assert validator_path(7, "voting") == "m/12381/3600/7/0/0"
+        assert validator_path(7, "withdrawal") == "m/12381/3600/7/0"
+
+
+class TestEip2335:
+    def test_scrypt_round_trip(self):
+        sk = SecretKey(123456789)
+        ks = Keystore.encrypt(sk, "pass💥word", path="m/12381/3600/0/0/0")
+        back = Keystore.from_json(ks.to_json())
+        assert back.decrypt("pass💥word").scalar == sk.scalar
+        assert back.pubkey == sk.public_key().to_bytes().hex()
+
+    def test_pbkdf2_round_trip(self):
+        sk = SecretKey(987654321)
+        ks = Keystore.encrypt(sk, "hunter2", kdf="pbkdf2")
+        assert Keystore.from_json(ks.to_json()).decrypt("hunter2").scalar == sk.scalar
+
+    def test_wrong_password_rejected(self):
+        ks = Keystore.encrypt(SecretKey(42), "right")
+        with pytest.raises(KeystoreError):
+            ks.decrypt("wrong")
+
+    def test_json_schema_fields(self):
+        ks = Keystore.encrypt(SecretKey(42), "pw")
+        data = json.loads(ks.to_json())
+        assert data["version"] == 4
+        assert data["crypto"]["cipher"]["function"] == "aes-128-ctr"
+        assert data["crypto"]["kdf"]["function"] == "scrypt"
+        assert data["crypto"]["checksum"]["function"] == "sha256"
+
+
+class TestWallet:
+    def test_create_and_derive_accounts(self):
+        w = Wallet.create("test-wallet", "walletpw", seed=bytes(range(32)))
+        ks0 = w.next_validator("walletpw", "kpw0")
+        ks1 = w.next_validator("walletpw", "kpw1")
+        assert w.payload["nextaccount"] == 2
+        sk0 = ks0.decrypt("kpw0")
+        sk1 = ks1.decrypt("kpw1")
+        assert sk0.scalar != sk1.scalar
+        # deterministic: same wallet seed -> same keys
+        w2 = Wallet.create("again", "x", seed=bytes(range(32)))
+        assert w2.next_validator("x", "y").decrypt("y").scalar == sk0.scalar
+
+    def test_wallet_round_trip(self):
+        w = Wallet.create("rt", "pw", seed=bytes(range(32)))
+        w2 = Wallet.from_json(w.to_json())
+        assert w2.unlock_seed("pw") == bytes(range(32))

@@ -1,0 +1,109 @@
+"""Slasher detection tests (coverage role of reference slasher/tests):
+double votes, surround votes both directions, double proposals, innocents
+untouched."""
+
+from lighthouse_tpu.slasher import Slasher
+from lighthouse_tpu.types import ChainSpec, MINIMAL, types_for
+from lighthouse_tpu.types.containers import (
+    AttestationData,
+    BeaconBlockHeader,
+    Checkpoint,
+    SignedBeaconBlockHeader,
+)
+
+T = types_for(MINIMAL)
+SPEC = ChainSpec.interop()
+
+
+def indexed(validators, source, target, root=b"\x01"):
+    return T.IndexedAttestation(
+        attesting_indices=tuple(validators),
+        data=AttestationData(
+            slot=target * MINIMAL.slots_per_epoch,
+            index=0,
+            beacon_block_root=root.ljust(32, b"\x00"),
+            source=Checkpoint(epoch=source, root=bytes(32)),
+            target=Checkpoint(epoch=target, root=bytes(32)),
+        ),
+        signature=b"\x00" * 96,
+    )
+
+
+def header(proposer, slot, graffiti=b"a"):
+    return SignedBeaconBlockHeader(
+        message=BeaconBlockHeader(
+            slot=slot,
+            proposer_index=proposer,
+            parent_root=graffiti.ljust(32, b"\x00"),
+            state_root=bytes(32),
+            body_root=bytes(32),
+        ),
+        signature=b"\x00" * 96,
+    )
+
+
+def make():
+    return Slasher(MINIMAL, SPEC, validator_capacity=64, history_epochs=64)
+
+
+class TestAttestations:
+    def test_double_vote_detected(self):
+        s = make()
+        s.accept_attestation(indexed([1, 2], 1, 2, b"\x0a"))
+        s.accept_attestation(indexed([2, 3], 1, 2, b"\x0b"))
+        atts, props = s.process_queued()
+        assert len(atts) == 1  # only validator 2 double-voted
+        sl = atts[0]
+        common = set(sl.attestation_1.attesting_indices) & set(
+            sl.attestation_2.attesting_indices
+        )
+        assert 2 in common
+
+    def test_surround_detected_new_surrounds_old(self):
+        s = make()
+        s.accept_attestation(indexed([5], 3, 4))
+        s.process_queued()
+        s.accept_attestation(indexed([5], 2, 6, b"\x0c"))  # surrounds (3,4)
+        atts, _ = s.process_queued()
+        assert len(atts) == 1
+
+    def test_surround_detected_new_surrounded_by_old(self):
+        s = make()
+        s.accept_attestation(indexed([7], 2, 6))
+        s.process_queued()
+        s.accept_attestation(indexed([7], 3, 4, b"\x0d"))  # surrounded by (2,6)
+        atts, _ = s.process_queued()
+        assert len(atts) == 1
+
+    def test_innocent_attestations_pass(self):
+        s = make()
+        s.accept_attestation(indexed([1], 1, 2))
+        s.accept_attestation(indexed([1], 2, 3))
+        s.accept_attestation(indexed([1], 3, 4))
+        atts, props = s.process_queued()
+        assert atts == [] and props == []
+
+    def test_same_attestation_repeated_is_fine(self):
+        s = make()
+        a = indexed([4], 1, 2)
+        s.accept_attestation(a)
+        s.accept_attestation(a)
+        atts, _ = s.process_queued()
+        assert atts == []
+
+
+class TestBlocks:
+    def test_double_proposal_detected(self):
+        s = make()
+        s.accept_block_header(header(9, 13, b"a"))
+        s.accept_block_header(header(9, 13, b"b"))
+        _, props = s.process_queued()
+        assert len(props) == 1
+        assert props[0].signed_header_1.message.proposer_index == 9
+
+    def test_same_block_twice_is_fine(self):
+        s = make()
+        s.accept_block_header(header(9, 13))
+        s.accept_block_header(header(9, 13))
+        _, props = s.process_queued()
+        assert props == []

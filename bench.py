@@ -29,7 +29,9 @@ def main() -> None:
     sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
     import jax
 
-    from __graft_entry__ import _example_batch
+    from __graft_entry__ import _arm_compilation_cache, _example_batch
+
+    _arm_compilation_cache()
     from lighthouse_tpu.crypto.bls.backends.jax_tpu import _verify_kernel
 
     args = _example_batch(n_sets, k_pk)

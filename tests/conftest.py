@@ -24,12 +24,12 @@ import jax  # noqa: E402
 
 jax.config.update("jax_platforms", "cpu")
 
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
 # Persistent compilation cache: the crypto kernels are large XLA programs
 # (Miller loops, exponentiation scans); caching compiled executables across
 # pytest runs turns repeat suite runs from ~minutes of compile into reloads.
-_cache_dir = os.path.join(os.path.dirname(__file__), "..", ".jax_cache")
-jax.config.update("jax_compilation_cache_dir", os.path.abspath(_cache_dir))
-jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
-jax.config.update("jax_persistent_cache_min_entry_size_bytes", 0)
+# Shared with bench.py / dryrun_multichip so all entry points hit one cache.
+from __graft_entry__ import _arm_compilation_cache  # noqa: E402
 
-sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+_arm_compilation_cache()

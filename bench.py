@@ -32,10 +32,10 @@ def main() -> None:
     from __graft_entry__ import _arm_compilation_cache, _example_batch
 
     _arm_compilation_cache()
-    from lighthouse_tpu.crypto.bls.backends.jax_tpu import _verify_kernel
+    from lighthouse_tpu.crypto.bls.backends.jax_tpu import verify_jit
 
     args = _example_batch(n_sets, k_pk)
-    kernel = _verify_kernel(n_sets, k_pk)
+    kernel = verify_jit
 
     ok = bool(jax.block_until_ready(kernel(*args)))  # compile + warm
     assert ok, "bench batch failed to verify"

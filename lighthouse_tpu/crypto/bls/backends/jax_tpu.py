@@ -42,7 +42,7 @@ W = L.W
 
 
 def _pk_limbs(pk) -> np.ndarray:
-    """PublicKey -> (3, W) Jacobian limbs, cached on the object."""
+    """PublicKey -> (3, W) projective limbs (Z = 1), cached on the object."""
     cached = getattr(pk, "_tpu_limbs", None)
     if cached is None:
         pt = pk.point
@@ -57,13 +57,13 @@ def _pk_limbs(pk) -> np.ndarray:
 
 
 def _sig_limbs(sig) -> np.ndarray:
-    """Signature -> (3, 2, W) Jacobian limbs (infinity -> Z = 0), cached."""
+    """Signature -> (3, 2, W) projective limbs (infinity -> (0, 1, 0)),
+    cached."""
     cached = getattr(sig, "_tpu_limbs", None)
     if cached is None:
         pt = sig.point
         out = np.zeros((3, 2, W), np.int32)
         if pt.inf:
-            out[0, 0] = L.to_limbs(1)
             out[1, 0] = L.to_limbs(1)
         else:
             out[0, 0] = L.to_limbs(pt.x.c0.n)
@@ -79,8 +79,7 @@ def _sig_limbs(sig) -> np.ndarray:
     return cached
 
 
-_INF_G1 = np.zeros((3, W), np.int32)
-_INF_G1[0, 0] = 1
+_INF_G1 = np.zeros((3, W), np.int32)  # projective infinity (0, 1, 0)
 _INF_G1[1, 0] = 1
 
 
@@ -103,24 +102,19 @@ def _field_draws_cached(message: bytes) -> np.ndarray:
 # --- device kernel ----------------------------------------------------------
 
 
-def _tree_reduce_add(p, F):
-    """Product (EC sum) over axis 0 by halving; length must be a power of 2."""
-    n = p.shape[0]
-    while n > 1:
-        half = n // 2
-        p = TC.add(p[:half], p[half:], F)
-        n = half
-    return p[0]
+_sum_points = TC.sum_points
 
 
-_NEG_G1_GEN_AFF = None
+# -G1 generator, affine, built host-side at import (a lazily jnp-computed
+# constant would leak a tracer when first touched inside a jit trace).
+from ..constants import G1_X as _G1_X, G1_Y as _G1_Y, P as _P  # noqa: E402
+
+_NEG_G1_GEN_AFF = jnp.asarray(
+    np.stack([L.to_limbs(_G1_X), L.to_limbs(_P - _G1_Y)])
+)  # (2, W)
 
 
 def _neg_g1_gen_aff():
-    global _NEG_G1_GEN_AFF
-    if _NEG_G1_GEN_AFF is None:
-        g = TC.G1_GEN
-        _NEG_G1_GEN_AFF = jnp.stack([g[0], L.neg(g[1])], axis=0)  # (2, W)
     return _NEG_G1_GEN_AFF
 
 
@@ -135,7 +129,7 @@ def verify_body(u, pk_jac, sig_jac, scalars, real, axis_name=None):
     reference's rayon map-reduce (block_signature_verifier.rs:374-384).
     """
     # per-set pubkey aggregation: (n, k, 3, W) -> (n, 3, W)
-    agg_pk = _tree_reduce_add(jnp.moveaxis(pk_jac, 1, 0), TC.FP)
+    agg_pk = _sum_points(jnp.moveaxis(pk_jac, 1, 0), TC.FP)
     agg_pk_bad = TC.is_infinity(agg_pk, TC.FP) & real
 
     # signature subgroup membership (padded sets hold infinity: passes)
@@ -149,9 +143,9 @@ def verify_body(u, pk_jac, sig_jac, scalars, real, axis_name=None):
     rpk = TC.scalar_mul_u64(agg_pk, scalars, TC.FP)
     rpk_aff, rpk_inf = TC.to_affine_g1(rpk)
     rsig = TC.scalar_mul_u64(sig_jac, scalars, TC.FP2)
-    ssum = _tree_reduce_add(rsig, TC.FP2)
+    ssum = _sum_points(rsig, TC.FP2)
     if axis_name is not None:
-        ssum = _tree_reduce_add(
+        ssum = _sum_points(
             jax.lax.all_gather(ssum, axis_name, axis=0), TC.FP2
         )
     ssum_aff, ssum_inf = TC.to_affine_g2(ssum[None])
@@ -182,13 +176,7 @@ def verify_body(u, pk_jac, sig_jac, scalars, real, axis_name=None):
 
 # One module-level jitted verifier: jax.jit itself caches one executable
 # per input-shape bucket, and never evicts warm shapes.
-_verify_jit = jax.jit(verify_body)
-
-
-def _verify_kernel(n_bucket: int = 0, k_bucket: int = 0):
-    """Kept as a function for callers that name the bucket explicitly
-    (bench.py); shape specialization is jit's own cache."""
-    return _verify_jit
+verify_jit = jax.jit(verify_body)
 
 
 def _bucket(n: int, floor: int = 4) -> int:
@@ -215,8 +203,7 @@ def verify_signature_sets(sets, seed=None) -> bool:
     u = np.zeros((n_b, 2, 2, W), np.int32)
     pk = np.broadcast_to(_INF_G1, (n_b, k_b, 3, W)).copy()
     sig = np.zeros((n_b, 3, 2, W), np.int32)
-    sig[:, 0, 0, 0] = 1
-    sig[:, 1, 0, 0] = 1
+    sig[:, 1, 0, 0] = 1  # projective infinity (0, 1, 0) on padded rows
     for i, s in enumerate(sets):
         u[i] = _field_draws_cached(s.message)
         for j, key in enumerate(s.pubkeys):
@@ -231,7 +218,7 @@ def verify_signature_sets(sets, seed=None) -> bool:
     real = np.zeros((n_b,), bool)
     real[:n] = True
 
-    kernel = _verify_kernel(n_b, k_b)
+    kernel = verify_jit
     return bool(
         kernel(
             jnp.asarray(u),

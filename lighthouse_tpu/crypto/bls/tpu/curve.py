@@ -1,24 +1,37 @@
-"""Batched G1/G2 Jacobian point arithmetic on the TPU limb representation.
+"""Batched G1/G2 point arithmetic on the TPU limb representation.
 
 Replaces blst's POINTonE1/POINTonE2 C/assembly group law (the code behind
 reference crypto/bls/src/impls/blst.rs aggregation at blst.rs:100-106 and the
 subgroup checks at blst.rs:72-82) with branchless, batch-first kernels:
 
-  * Points are stacked Jacobian coordinate arrays -- G1: (..., 3, W),
-    G2: (..., 3, 2, W) -- limbs last, batch axes leading. Infinity is Z == 0,
-    so doubling is exception-free and addition handles infinity by select.
+  * Points are stacked HOMOGENEOUS PROJECTIVE coordinate arrays -- G1:
+    (..., 3, W), G2: (..., 3, 2, W) -- limbs last, batch axes leading.
+    x = X/Z, y = Y/Z; infinity is (0, 1, 0).
+  * The group law is the Renes-Costello-Batina COMPLETE addition for
+    j-invariant-0 short Weierstrass curves (eprint 2015/1060, algorithms
+    7/9 specialized to a = 0). BLS12-381's E(Fp) and E'(Fp2) both have odd
+    order (cofactors 0x396c...aaab and 0x5d54...8e5 are odd), so the curves
+    carry no 2-torsion and the formulas are complete for EVERY on-curve
+    input pair, including infinity and P == +-Q. This removes all
+    exceptional-case handling -- no exact zero-tests (canonicalization),
+    no inlined doubling fallback, no selects -- from the group law, which
+    is what makes the compiled program per add ~3x smaller than a complete
+    Jacobian add and keeps ladder scan bodies compact.
   * One generic group law is instantiated over both fields through a tiny
     field-ops namespace (`FP`, `FP2`); no per-curve duplication.
   * Scalar multiplication is a `lax.scan` double-and-add over either a
     compile-time exponent (subgroup checks, cofactors) or runtime 64-bit
     scalars (the random-linear-combination weights of batch verification,
     reference blst.rs:45-57) -- constant program size, fully batched.
-  * The exceptional add cases (P == Q, P == -Q) are resolved branchlessly:
-    exact zero tests of H and r via canonicalization, then select between
-    the add result, the doubling result, and infinity.
-  * psi (untwist-Frobenius-twist) acts coordinate-wise on Jacobian points,
-    giving the fast G2 subgroup check psi(P) == [x]P (blst's check; oracle
-    cross-validated in curve_ref.g2_subgroup_check_psi).
+  * psi (untwist-Frobenius-twist) acts coordinate-wise, and homogeneous
+    coordinates are scaling-invariant, so psi(X:Y:Z) = (cx conj(X) :
+    cy conj(Y) : conj(Z)) needs no normalization; it feeds the fast G2
+    subgroup check psi(P) == [x]P (blst's check; oracle cross-validated in
+    curve_ref.g2_subgroup_check_psi).
+  * Cross-set point sums (pubkey aggregation, the weighted-signature sum)
+    use `sum_points`: a halving reduction expressed as ONE scanned
+    body instead of log2(n) inlined tree levels, trading ~2x redundant
+    adds (on infinity padding) for log-fold smaller programs.
 
 Differentially tested against the pure-Python oracle (curve_ref.py) in
 tests/test_tpu_curve.py.
@@ -58,6 +71,11 @@ class FP:
     eq = staticmethod(L.eq)
 
     @staticmethod
+    def mul_b3(a):
+        """b3 = 3b = 12 for E: y^2 = x^3 + 4."""
+        return L.mul_small(a, 12)
+
+    @staticmethod
     def one(shape=()):
         return jnp.broadcast_to(L.ONE, shape + (W,))
 
@@ -87,6 +105,11 @@ class FP2:
     zero = staticmethod(T.fp2_zero)
     select = staticmethod(T.fp2_select)
 
+    @staticmethod
+    def mul_b3(a):
+        """b3 = 3b = 12(1 + u) for E': y^2 = x^3 + 4(1 + u)."""
+        return T.fp2_mul_by_xi(T.fp2_mul_small(a, 12))
+
 
 def _coord(p, i, F):
     return p[(Ellipsis, i) + (slice(None),) * F.coord_ndim]
@@ -105,95 +128,63 @@ def is_infinity(p, F):
 
 
 def infinity(F, shape=()):
-    """Jacobian infinity (1, 1, 0) -- a valid exception-free doubling input."""
-    return _pack(F.one(shape), F.one(shape), F.zero(shape), F)
+    """Projective infinity (0, 1, 0)."""
+    return _pack(F.zero(shape), F.one(shape), F.zero(shape), F)
 
 
-# --- generic Jacobian group law (curve y^2 = x^3 + b, a = 0) ---------------
-
-
-def double(p, F):
-    """dbl-2009-l, exception-free for a = 0: Z == 0 or Y == 0 -> Z3 == 0."""
-    x, y, z = _coord(p, 0, F), _coord(p, 1, F), _coord(p, 2, F)
-    a = F.sq(x)
-    b = F.sq(y)
-    c = F.sq(b)
-    d = F.mul_small(F.sub(F.sub(F.sq(F.add(x, b)), a), c), 2)
-    e = F.mul_small(a, 3)
-    f = F.sq(e)
-    x3 = F.sub(f, F.mul_small(d, 2))
-    y3 = F.sub(F.mul(e, F.sub(d, x3)), F.mul_small(c, 8))
-    z3 = F.mul(F.mul_small(y, 2), z)
-    return _pack(x3, y3, z3, F)
+# --- complete group law (RCB 2015, a = 0) ----------------------------------
 
 
 def add(p, q, F):
-    """Complete Jacobian add: add-2007-bl with branchless resolution of the
-    exceptional cases (either input at infinity; P == Q; P == -Q)."""
+    """Complete projective addition (RCB eprint 2015/1060, algorithm 7 for
+    a = 0): 12M + 2 b3-mults, branchless, valid for every on-curve pair
+    including infinity and P == +-Q (the curves have odd order)."""
     x1, y1, z1 = _coord(p, 0, F), _coord(p, 1, F), _coord(p, 2, F)
     x2, y2, z2 = _coord(q, 0, F), _coord(q, 1, F), _coord(q, 2, F)
-    z1z1 = F.sq(z1)
-    z2z2 = F.sq(z2)
-    u1 = F.mul(x1, z2z2)
-    u2 = F.mul(x2, z1z1)
-    s1 = F.mul(F.mul(y1, z2), z2z2)
-    s2 = F.mul(F.mul(y2, z1), z1z1)
-    h = F.sub(u2, u1)
-    r = F.sub(s2, s1)
-    i = F.sq(F.mul_small(h, 2))
-    j = F.mul(h, i)
-    r2 = F.mul_small(r, 2)
-    v = F.mul(u1, i)
-    x3 = F.sub(F.sub(F.sq(r2), j), F.mul_small(v, 2))
-    y3 = F.sub(F.mul(r2, F.sub(v, x3)), F.mul_small(F.mul(s1, j), 2))
-    z3 = F.mul(F.mul(F.sub(F.sub(F.sq(F.add(z1, z2)), z1z1), z2z2), h), F.one())
-    out = _pack(x3, y3, z3, F)
+    t0 = F.mul(x1, x2)
+    t1 = F.mul(y1, y2)
+    t2 = F.mul(z1, z2)
+    t3 = F.mul(F.add(x1, y1), F.add(x2, y2))
+    t3 = F.sub(t3, F.add(t0, t1))  # x1 y2 + x2 y1
+    t4 = F.mul(F.add(y1, z1), F.add(y2, z2))
+    t4 = F.sub(t4, F.add(t1, t2))  # y1 z2 + y2 z1
+    x3 = F.mul(F.add(x1, z1), F.add(x2, z2))
+    y3 = F.sub(x3, F.add(t0, t2))  # x1 z2 + x2 z1
+    x3 = F.mul_small(t0, 3)  # 3 x1 x2, one normalization
+    t2 = F.mul_b3(t2)
+    z3 = F.add(t1, t2)
+    t1 = F.sub(t1, t2)
+    y3 = F.mul_b3(y3)
+    x3_out = F.sub(F.mul(t3, t1), F.mul(t4, y3))
+    y3_out = F.add(F.mul(y3, x3), F.mul(t1, z3))
+    z3_out = F.add(F.mul(z3, t4), F.mul(x3, t3))
+    return _pack(x3_out, y3_out, z3_out, F)
 
-    p_inf = is_infinity(p, F)
-    q_inf = is_infinity(q, F)
-    h_zero = F.is_zero(h)
-    r_zero = F.is_zero(r)
-    # same x, same y -> double; same x, opposite y -> infinity
-    out = point_select(h_zero & ~p_inf & ~q_inf, double(p, F), out, F)
-    out = point_select(
-        h_zero & ~r_zero & ~p_inf & ~q_inf, infinity(F, p_inf.shape), out, F
-    )
-    out = point_select(q_inf, p, out, F)
-    out = point_select(p_inf, q, out, F)
-    return out
+
+def double(p, F):
+    """Complete projective doubling (RCB algorithm 9 for a = 0):
+    6M + 2S + 1 b3-mult, branchless, handles infinity natively."""
+    x, y, z = _coord(p, 0, F), _coord(p, 1, F), _coord(p, 2, F)
+    t0 = F.sq(y)
+    z3 = F.mul_small(t0, 8)  # 8 Y^2, one normalization
+    t1 = F.mul(y, z)
+    t2 = F.mul_b3(F.sq(z))
+    x3 = F.mul(t2, z3)
+    y3 = F.add(t0, t2)
+    z3 = F.mul(t1, z3)
+    t0 = F.sub(t0, F.mul_small(t2, 3))
+    y3 = F.add(F.mul(t0, y3), x3)
+    t1 = F.mul(t0, F.mul(x, y))
+    x3 = F.add(t1, t1)
+    return _pack(x3, y3, z3, F)
 
 
 def add_mixed(p, q_aff, q_inf, F):
-    """Jacobian + affine (madd-2007-bl): q_aff = (x2, y2) stacked (..., 2, ...),
-    q_inf a bool mask. Saves the Z2 work in scalar-mul ladders."""
-    x1, y1, z1 = _coord(p, 0, F), _coord(p, 1, F), _coord(p, 2, F)
-    x2, y2 = _coord(q_aff, 0, F), _coord(q_aff, 1, F)
-    z1z1 = F.sq(z1)
-    u2 = F.mul(x2, z1z1)
-    s2 = F.mul(F.mul(y2, z1), z1z1)
-    h = F.sub(u2, x1)
-    r = F.sub(s2, y1)
-    i = F.sq(F.mul_small(h, 2))
-    j = F.mul(h, i)
-    r2 = F.mul_small(r, 2)
-    v = F.mul(x1, i)
-    x3 = F.sub(F.sub(F.sq(r2), j), F.mul_small(v, 2))
-    y3 = F.sub(F.mul(r2, F.sub(v, x3)), F.mul_small(F.mul(y1, j), 2))
-    z3 = F.mul(F.sub(F.sq(F.add(z1, h)), F.add(z1z1, F.sq(h))), F.one())
-    out = _pack(x3, y3, z3, F)
-
-    p_inf = is_infinity(p, F)
-    h_zero = F.is_zero(h)
-    r_zero = F.is_zero(r)
-    out = point_select(h_zero & ~p_inf & ~q_inf, double(p, F), out, F)
-    out = point_select(
-        h_zero & ~r_zero & ~p_inf & ~q_inf, infinity(F, p_inf.shape), out, F
-    )
-    q_jac = _pack(x2, y2, F.one(x2.shape[: x2.ndim - F.coord_ndim]), F)
-    out = point_select(p_inf & ~q_inf, q_jac, out, F)
-    out = point_select(p_inf & q_inf, p, out, F)
-    out = point_select(q_inf & ~p_inf, p, out, F)
-    return out
+    """Projective + affine: q_aff = (x2, y2) stacked (..., 2, ...), q_inf a
+    bool mask. Lifts q to projective and uses the complete law (the RCB
+    mixed variant saves 1M but cannot represent affine infinity; the lift
+    keeps completeness)."""
+    return add(p, from_affine(q_aff, q_inf, F), F)
 
 
 def neg(p, F):
@@ -202,13 +193,12 @@ def neg(p, F):
 
 
 def eq(p, q, F):
-    """Jacobian equality: X1 Z2^2 == X2 Z1^2 and Y1 Z2^3 == Y2 Z1^3, with
+    """Projective equality: X1 Z2 == X2 Z1 and Y1 Z2 == Y2 Z1, with
     infinity equal only to infinity."""
     x1, y1, z1 = _coord(p, 0, F), _coord(p, 1, F), _coord(p, 2, F)
     x2, y2, z2 = _coord(q, 0, F), _coord(q, 1, F), _coord(q, 2, F)
-    z1z1, z2z2 = F.sq(z1), F.sq(z2)
-    same_x = F.eq(F.mul(x1, z2z2), F.mul(x2, z1z1))
-    same_y = F.eq(F.mul(F.mul(y1, z2), z2z2), F.mul(F.mul(y2, z1), z1z1))
+    same_x = F.eq(F.mul(x1, z2), F.mul(x2, z1))
+    same_y = F.eq(F.mul(y1, z2), F.mul(y2, z1))
     p_inf, q_inf = is_infinity(p, F), is_infinity(q, F)
     return (p_inf & q_inf) | (~p_inf & ~q_inf & same_x & same_y)
 
@@ -256,20 +246,49 @@ def scalar_mul_u64(p, scalars, F):
     return out
 
 
+# --- cross-set reductions ---------------------------------------------------
+
+
+def sum_points(p, F):
+    """EC sum over axis 0 (any length; pads to a power of two with
+    infinity) as ONE scanned halving body: each iteration adds adjacent
+    pairs into the front half and refills the back half with infinity.
+    log2(n) iterations; compiled program size is a single complete add
+    regardless of n."""
+    n = p.shape[0]
+    m = 1
+    while m < n:
+        m *= 2
+    batch = p.shape[1 : p.ndim - F.coord_ndim - 1]
+    if m > n:
+        p = jnp.concatenate([p, infinity(F, (m - n,) + batch)], axis=0)
+    if m == 1:
+        return p[0]
+    half = m // 2
+    pad = infinity(F, (half,) + batch)
+    steps = m.bit_length() - 1
+
+    def body(acc, _):
+        s = add(acc[0::2], acc[1::2], F)
+        return jnp.concatenate([s, pad], axis=0), None
+
+    out, _ = jax.lax.scan(body, p, None, length=steps)
+    return out[0]
+
+
 # --- affine conversion ------------------------------------------------------
 
 
 def to_affine_g1(p):
-    """Batched Jacobian -> affine for G1 (one Fermat inversion total via
+    """Batched projective -> affine for G1 (one Fermat inversion total via
     Montgomery batch inversion). Infinity maps to (0, 0) + mask."""
     x, y, z = p[..., 0, :], p[..., 1, :], p[..., 2, :]
     inf = L.is_zero(z)
     z_safe = L.select(inf, jnp.broadcast_to(L.ONE, z.shape), z)
     flat = z_safe.reshape(-1, W)
     zinv = T.fp_batch_inv(flat, axis=0).reshape(z.shape)
-    zinv2 = L.sq(zinv)
-    ax = L.mul(x, zinv2)
-    ay = L.mul(y, L.mul(zinv2, zinv))
+    ax = L.mul(x, zinv)
+    ay = L.mul(y, zinv)
     zero = jnp.zeros_like(ax)
     return (
         jnp.stack([L.select(inf, zero, ax), L.select(inf, zero, ay)], axis=-2),
@@ -283,9 +302,8 @@ def to_affine_g2(p):
     z_safe = T.fp2_select(inf, T.fp2_one(z.shape[:-2]), z)
     flat = z_safe.reshape(-1, 2, W)
     zinv = T.fp2_batch_inv(flat, axis=0).reshape(z.shape)
-    zinv2 = T.fp2_sq(zinv)
-    ax = T.fp2_mul(x, zinv2)
-    ay = T.fp2_mul(y, T.fp2_mul(zinv2, zinv))
+    ax = T.fp2_mul(x, zinv)
+    ay = T.fp2_mul(y, zinv)
     zero = jnp.zeros_like(ax)
     return (
         jnp.stack(
@@ -296,23 +314,22 @@ def to_affine_g2(p):
 
 
 def from_affine(aff, inf, F):
-    """(..., 2, coord) affine + inf mask -> Jacobian; infinity -> (1, 1, 0)."""
+    """(..., 2, coord) affine + inf mask -> projective; infinity -> (0, 1, 0)."""
     x, y = _coord(aff, 0, F), _coord(aff, 1, F)
     shape = inf.shape
     z = F.select(inf, F.zero(shape), F.one(shape))
     one = F.one(shape)
-    return _pack(F.select(inf, one, x), F.select(inf, one, y), z, F)
+    return _pack(F.select(inf, F.zero(shape), x), F.select(inf, one, y), z, F)
 
 
 # --- host <-> device --------------------------------------------------------
 
 
 def g1_pack(points) -> jnp.ndarray:
-    """Oracle affine G1 points -> (n, 3, W) Jacobian device array."""
+    """Oracle affine G1 points -> (n, 3, W) projective device array."""
     out = np.zeros((len(points), 3, W), np.int32)
     for i, pt in enumerate(points):
         if pt.inf:
-            out[i, 0] = L.to_limbs(1)
             out[i, 1] = L.to_limbs(1)
         else:
             out[i, 0] = L.to_limbs(pt.x.n)
@@ -322,11 +339,10 @@ def g1_pack(points) -> jnp.ndarray:
 
 
 def g2_pack(points) -> jnp.ndarray:
-    """Oracle affine G2 points -> (n, 3, 2, W) Jacobian device array."""
+    """Oracle affine G2 points -> (n, 3, 2, W) projective device array."""
     out = np.zeros((len(points), 3, 2, W), np.int32)
     for i, pt in enumerate(points):
         if pt.inf:
-            out[i, 0, 0] = L.to_limbs(1)
             out[i, 1, 0] = L.to_limbs(1)
         else:
             out[i, 0, 0] = L.to_limbs(pt.x.c0.n)
@@ -338,7 +354,7 @@ def g2_pack(points) -> jnp.ndarray:
 
 
 def g1_unpack(p) -> list:
-    """(n, 3, W) Jacobian device array -> oracle affine points (host)."""
+    """(n, 3, W) projective device array -> oracle affine points (host)."""
     aff, inf = to_affine_g1(p)
     aff, inf = np.asarray(aff), np.asarray(inf)
     out = []
@@ -376,8 +392,9 @@ _X_ABS = -BLS_X
 
 
 def psi(p):
-    """Jacobian psi: (cx conj(X), cy conj(Y), conj(Z)) -- conjugation
-    commutes with the Jacobian scaling, so no normalization is needed."""
+    """Projective psi: (cx conj(X), cy conj(Y), conj(Z)) -- conjugation and
+    the coefficient scalings commute with the projective scaling, so no
+    normalization is needed."""
     x, y, z = p[..., 0, :, :], p[..., 1, :, :], p[..., 2, :, :]
     return jnp.stack(
         [
@@ -403,21 +420,18 @@ def g1_subgroup_check(p) -> jnp.ndarray:
 
 
 def on_curve_g1(p) -> jnp.ndarray:
-    """Y^2 == X^3 + 4 Z^6 (Jacobian form); infinity passes."""
+    """Y^2 Z == X^3 + 4 Z^3 (projective form); infinity passes."""
     x, y, z = p[..., 0, :], p[..., 1, :], p[..., 2, :]
-    z2 = L.sq(z)
-    lhs = L.sq(y)
-    rhs = L.add(L.mul(L.sq(x), x), L.mul_small(L.mul(L.sq(z2), z2), 4))
+    lhs = L.mul(L.sq(y), z)
+    rhs = L.add(L.mul(L.sq(x), x), L.mul_small(L.mul(L.sq(z), z), 4))
     return L.eq(lhs, rhs) | is_infinity(p, FP)
 
 
 def on_curve_g2(p) -> jnp.ndarray:
-    """Y^2 == X^3 + (4 + 4u) Z^6; infinity passes."""
+    """Y^2 Z == X^3 + (4 + 4u) Z^3; infinity passes."""
     x, y, z = p[..., 0, :, :], p[..., 1, :, :], p[..., 2, :, :]
-    z2 = T.fp2_sq(z)
-    z6 = T.fp2_mul(T.fp2_sq(z2), z2)
-    b = T.fp2_mul_by_xi(T.fp2_mul_small(z6, 4))  # (4 + 4u) z^6
-    lhs = T.fp2_sq(y)
+    b = T.fp2_mul_by_xi(T.fp2_mul_small(T.fp2_mul(T.fp2_sq(z), z), 4))
+    lhs = T.fp2_mul(T.fp2_sq(y), z)
     rhs = T.fp2_add(T.fp2_mul(T.fp2_sq(x), x), b)
     return T.fp2_eq(lhs, rhs) | is_infinity(p, FP2)
 

@@ -7,11 +7,17 @@ crypto/bls/src/impls/blst.rs:14,90-98) with a host/device split:
     calls per message, vectorized over the batch with hashlib; emits the
     (n, 2, 2, W) limb tensor of field draws (2 Fp2 elements per message).
   * Device (all batched, branchless): simplified SWU on E2', the 3-isogeny
-    E2' -> E2 with denominators folded into the Jacobian Z (zero inversions:
-    Z = xd*yd, X = xn*xd*yd^2, Y = y*yn*xd^3*yd^2 -- isogeny poles land on
-    Z = 0 = infinity exactly as RFC 6.6.3 requires), point addition of the
-    two maps, and Budroni-Pintore cofactor clearing via the psi endomorphism
-    ([x]-ladders; x has Hamming weight 6).
+    E2' -> E2 emitting PROJECTIVE coordinates with the denominators folded
+    into Z (zero inversions: Z = xd*yd, X = xn*yd, Y = y*yn*xd -- isogeny
+    poles land on Z = 0 = infinity exactly as RFC 6.6.3 requires), point
+    addition of the two maps via the complete projective law, and
+    Budroni-Pintore cofactor clearing via the psi endomorphism.
+  * Program-size discipline: every identical computation runs ONCE on a
+    stacked batch instead of once per operand -- the SSWU map and isogeny
+    are evaluated with the two field draws as an extra batch axis, the two
+    candidate square roots inside sqrt share one exponentiation scan, and
+    the two independent cofactor ladders ([x](xP - P) and [x]psi(P)) run
+    stacked in one scan instance.
   * Fp2 square roots use the complex method (p = 3 mod 4): candidate roots
     from static-exponent scans, validity decided by squaring back -- no
     data-dependent branching anywhere.
@@ -80,15 +86,17 @@ def fp2_sqrt(a):
     norm = c0^2 + c1^2, alpha = sqrt(norm); root = (x0, c1 / (2 x0)) with
     x0 = sqrt((c0 +- alpha)/2). The c1 == 0 corner (root is sqrt(c0) or
     u * sqrt(-c0)) is folded in by select. Everything verified by squaring,
-    so wrong candidates can never report is_square.
+    so wrong candidates can never report is_square. The four Fp sqrt
+    candidates (d1, d2, c0, -c0) share ONE exponentiation scan on a
+    stacked axis.
     """
     c0, c1 = a[..., 0, :], a[..., 1, :]
     norm = L.add(L.sq(c0), L.sq(c1))
     alpha = _fp_sqrt_cand(norm)
     d1 = L.mul(L.add(c0, alpha), _INV2)
     d2 = L.mul(L.sub(c0, alpha), _INV2)
-    x0a = _fp_sqrt_cand(d1)
-    x0b = _fp_sqrt_cand(d2)
+    cands = _fp_sqrt_cand(jnp.stack([d1, d2, c0, L.neg(c0)], axis=0))
+    x0a, x0b, s_pos, s_neg = cands[0], cands[1], cands[2], cands[3]
     use_a = L.eq(L.sq(x0a), d1)
     x0 = L.select(use_a, x0a, x0b)
     x1 = L.mul(L.mul(c1, _INV2), T.fp_inv(x0))
@@ -96,8 +104,6 @@ def fp2_sqrt(a):
 
     # c1 == 0: root is (sqrt(c0), 0) or (0, sqrt(-c0)) since u^2 = -1
     c1_zero = L.is_zero(c1)
-    s_pos = _fp_sqrt_cand(c0)
-    s_neg = _fp_sqrt_cand(L.neg(c0))
     pos_ok = L.eq(L.sq(s_pos), c0)
     zero_limb = jnp.zeros_like(c0)
     cand_c1z = T.fp2_select(
@@ -136,7 +142,8 @@ _NEG_B_OVER_A_DEV = jnp.asarray(
 
 
 def map_to_curve_sswu(u):
-    """Simplified SWU on E2' (RFC 9380 6.6.2), branchless: (x, y) on E2'."""
+    """Simplified SWU on E2' (RFC 9380 6.6.2), branchless: (x, y) on E2'.
+    Shape-polymorphic; the two square roots share one stacked sqrt call."""
     u2 = T.fp2_sq(u)
     zu2 = T.fp2_mul(_Z, u2)
     tv1 = T.fp2_add(T.fp2_sq(zu2), zu2)
@@ -144,14 +151,16 @@ def map_to_curve_sswu(u):
     x1_main = T.fp2_mul(
         _NEG_B_OVER_A_DEV, T.fp2_add(T.fp2_inv(tv1), T.fp2_one(tv1_zero.shape))
     )
-    x1 = T.fp2_select(tv1_zero, jnp.broadcast_to(_B_OVER_ZA_DEV, x1_main.shape), x1_main)
+    x1 = T.fp2_select(
+        tv1_zero, jnp.broadcast_to(_B_OVER_ZA_DEV, x1_main.shape), x1_main
+    )
     gx1 = T.fp2_add(T.fp2_mul(T.fp2_add(T.fp2_sq(x1), _A), x1), _B)
     x2 = T.fp2_mul(zu2, x1)
     gx2 = T.fp2_add(T.fp2_mul(T.fp2_add(T.fp2_sq(x2), _A), x2), _B)
-    y1, ok1 = fp2_sqrt(gx1)
-    y2, _ = fp2_sqrt(gx2)
+    y_st, ok_st = fp2_sqrt(jnp.stack([gx1, gx2], axis=0))
+    ok1 = ok_st[0]
     x = T.fp2_select(ok1, x1, x2)
-    y = T.fp2_select(ok1, y1, y2)
+    y = T.fp2_select(ok1, y_st[0], y_st[1])
     flip = fp2_sgn0(u) != fp2_sgn0(y)
     y = T.fp2_select(flip, T.fp2_neg(y), y)
     return x, y
@@ -176,19 +185,24 @@ def _horner(coeffs, x):
     return acc
 
 
-def iso3_map_jacobian(x, y):
-    """3-isogeny E2' -> E2 emitting Jacobian coordinates, no inversions:
-    Z = xd*yd, X = xn*xd*yd^2, Y = y*yn*xd^3*yd^2. Poles -> Z = 0."""
+def iso3_map_projective(x, y):
+    """3-isogeny E2' -> E2 emitting projective coordinates, no inversions:
+    Z = xd*yd, X = xn*yd, Y = y*yn*xd. Poles (RFC 6.6.3: iso_map sends
+    them to the point at infinity) are canonicalized to (0, 1, 0) -- the
+    complete add's identity -- rather than left as (0, 0, 0), which is not
+    on the curve and would absorb the other map's point in the q0 + q1 sum."""
     xn = _horner(_XN, x)
     xd = _horner(_XD, x)
     yn = _horner(_YN, x)
     yd = _horner(_YD, x)
-    z = T.fp2_mul(xd, yd)
-    yd2 = T.fp2_sq(yd)
-    xd2 = T.fp2_sq(xd)
-    X = T.fp2_mul(T.fp2_mul(xn, xd), yd2)
-    Y = T.fp2_mul(T.fp2_mul(T.fp2_mul(y, yn), T.fp2_mul(xd2, xd)), yd2)
-    return jnp.stack([X, Y, z], axis=-3)
+    Z = T.fp2_mul(xd, yd)
+    X = T.fp2_mul(xn, yd)
+    Y = T.fp2_mul(T.fp2_mul(y, yn), xd)
+    inf = T.fp2_is_zero(Z)
+    one = T.fp2_one(inf.shape)
+    X = T.fp2_select(inf, T.fp2_zero(inf.shape), X)
+    Y = T.fp2_select(inf, one, Y)
+    return jnp.stack([X, Y, Z], axis=-3)
 
 
 # --- cofactor clearing (Budroni-Pintore, via psi) --------------------------
@@ -203,29 +217,33 @@ def _mul_by_x(p):
 
 def clear_cofactor(p):
     """[x^2-x-1]P + [x-1]psi(P) + psi(psi([2]P)) (RFC 9380 appendix).
-    Structured as three [x]-ladders: [x^2-x-1]P = [x]([x]P - P) - P."""
-    a = _mul_by_x(p)
+    Structured as three [x]-ladders; the two independent ones ([x] of
+    xP - P and of psi(P)) run stacked in a single scan instance."""
+    a = _mul_by_x(p)  # ladder 1: [x]P
     amp = C.add(a, C.neg(p, C.FP2), C.FP2)  # [x]P - P
-    t0 = C.add(_mul_by_x(amp), C.neg(p, C.FP2), C.FP2)
     psip = C.psi(p)
-    t1 = C.add(_mul_by_x(psip), C.neg(psip, C.FP2), C.FP2)
+    stacked = _mul_by_x(jnp.stack([amp, psip], axis=0))  # ladder 2 (shared)
+    minus = jnp.stack([C.neg(p, C.FP2), C.neg(psip, C.FP2)], axis=0)
+    t01 = C.add(stacked, minus, C.FP2)  # [t0, t1] in one add instance
     t2 = C.psi(C.psi(C.double(p, C.FP2)))
-    return C.add(C.add(t0, t1, C.FP2), t2, C.FP2)
+    # t0 + t1 + t2 as one scanned sum (single add body in program)
+    return C.sum_points(jnp.concatenate([t01, t2[None]], axis=0), C.FP2)
 
 
 # --- full pipeline ----------------------------------------------------------
 
 
 def map_to_g2(u):
-    """(n, 2, 2, W) field draws -> (n, 3, 2, W) Jacobian G2 points in the
-    r-torsion: SSWU both draws, isogeny, add, clear cofactor."""
-    x0, y0 = map_to_curve_sswu(u[..., 0, :, :])
-    x1, y1 = map_to_curve_sswu(u[..., 1, :, :])
-    q = C.add(iso3_map_jacobian(x0, y0), iso3_map_jacobian(x1, y1), C.FP2)
+    """(n, 2, 2, W) field draws -> (n, 3, 2, W) projective G2 points in the
+    r-torsion: SSWU both draws (as one stacked batch), isogeny, add, clear
+    cofactor."""
+    x, y = map_to_curve_sswu(u)  # batch (..., 2) over the two draws
+    q = iso3_map_projective(x, y)  # (..., 2, 3, 2, W)
+    q = C.add(q[..., 0, :, :, :], q[..., 1, :, :, :], C.FP2)
     return clear_cofactor(q)
 
 
 def hash_to_g2(messages, dst: bytes = DST):
-    """Host+device: [bytes] -> (n, 3, 2, W) Jacobian G2 points."""
+    """Host+device: [bytes] -> (n, 3, 2, W) projective G2 points."""
     u = jnp.asarray(hash_to_field(messages, dst))
     return map_to_g2(u)

@@ -1,24 +1,32 @@
-"""TPU-native Fp arithmetic for BLS12-381: 13-bit signed int32 limbs.
+"""TPU-native Fp arithmetic for BLS12-381: 12-bit signed int32 limbs.
 
 This layer replaces blst's C/assembly big-int core (the FFI boundary at
 reference crypto/bls/src/impls/blst.rs). The design is driven by TPU/XLA
 constraints, not CPU big-int idioms:
 
-  * No 64-bit multiply on the VPU -> limbs are 13 bits in int32 lanes, so a
-    schoolbook column sum (31 products of <= 2^26 each = 2^30.95) never
-    overflows a signed 32-bit accumulator.
+  * No 64-bit multiply on the VPU -> limbs are 12 bits in int32 lanes, so a
+    schoolbook column sum (35 products of <= 2^24 each < 2^29.2) never
+    overflows a signed 32-bit accumulator -- and leaves enough headroom to
+    COMBINE up to three raw column vectors before a single shared modular
+    reduction. That column-domain sharing is what the Fp2 Karatsuba in
+    tower.py exploits: 3 column products + 2 reductions instead of
+    3 full multiplies (3 reductions) + 4 normalizing additions.
   * Carries are LAZY and fully data-parallel: three shift/add rounds bring
-    any int32 column vector to limbs in [-1, 2^13]; no sequential scan in the
-    hot path.
-  * Modular reduction is a constant-matrix fold: limbs above position 30 are
-    contracted with FOLD_R[j] = limbs(2^(13*(30+j)) mod p), a compile-time
-    constant, chunked so partial sums stay under 2^31.
-  * Working values use W = 31 limbs -- one guard limb of headroom -- in a
-    redundant "lazy" form: limbs in [-1, 2^13], |value| < 2^392, congruent
-    mod p. The guard limb is what makes hot-path truncation safe: a value
-    bounded by 2^393 can never populate limb 31 (weight 2^403) after carry.
+    any int32 column vector to limbs in [-1, 2^12]; no sequential scan in
+    the hot path.
+  * Modular reduction is a constant-matrix fold: limbs above position 32
+    are contracted with FOLD_R[j] = limbs(2^(12*(32+j)) mod p) in ONE
+    einsum (row products <= 2^24, 44 rows < 2^29.5 -- no chunking).
+  * Working width W = NLIMBS + 3 = 35 equals the natural carry3 output
+    width of a fold round, so `_truncate` NEVER drops a potentially
+    nonzero limb: positive values stay far below limb 35's weight (2^420)
+    and negative borrows park at limb 34 (weight 2^408), which is kept.
+    The lazy form is: limbs in [-1, 2^12], |value| < 2^397, congruent
+    mod p.
   * Exact canonicalization (canon) happens only at boundaries (equality,
-    serialization) via lax.scan carries + a float32 Barrett quotient step.
+    serialization): shift positive by a fixed multiple of p, one exact
+    carry scan, a float32 Barrett quotient, then one table-indexed
+    subtraction of a small multiple of p.
 
 All functions are shape-polymorphic over leading batch axes (limbs on the
 LAST axis); batching never needs vmap. Differentially tested against the
@@ -35,16 +43,15 @@ import jax.numpy as jnp
 
 from ..constants import P
 
-BITS = 13
-NLIMBS = 30  # canonical width: 390 bits >= 381
-W = NLIMBS + 1  # working width (one guard limb)
+BITS = 12
+NLIMBS = 32  # canonical width: 384 bits >= 381
+W = NLIMBS + 3  # working width == carry3-output width of a fold round
 BASE = 1 << BITS
 MASK = BASE - 1
-_FOLD_CHUNK = 16  # rows per fold contraction: 16 * 2^26 + slack < 2^31
 
 
 def to_limbs(x: int, width: int = W) -> np.ndarray:
-    """Host: python int in [0, 2^(13*width)) -> int32[width]."""
+    """Host: python int in [0, 2^(BITS*width)) -> int32[width]."""
     assert 0 <= x < (1 << (BITS * width))
     out = np.empty(width, np.int32)
     for i in range(width):
@@ -62,8 +69,10 @@ def to_int(a) -> int:
     return val
 
 
-# Fold matrix: FOLD_R[j] = limbs(2^(BITS*(NLIMBS+j)) mod P), entries in [0, 2^13).
-# Width W rows cover the widest fold input (a 61-column product + carry slack).
+# Fold matrix: FOLD_R[j] = limbs(2^(BITS*(NLIMBS+j)) mod P), entries in
+# [0, 2^12). Rows cover the widest fold input (a 69-column product + carry
+# slack). Row products are <= 2^24, so all 44 rows contract in ONE einsum
+# (44 * 2^24 < 2^29.5, far under int32).
 _N_FOLD_ROWS = 2 * W + 6 - NLIMBS
 FOLD_R = jnp.asarray(
     np.stack(
@@ -73,8 +82,12 @@ FOLD_R = jnp.asarray(
 )
 
 P_LIMBS = jnp.asarray(to_limbs(P), jnp.int32)  # width W
-# p * 2^11, for the split Barrett quotient subtraction in canon()
-_P11_LIMBS = jnp.asarray(to_limbs(P << 11), jnp.int32)
+# p * 2^BITS, for the split Barrett quotient subtraction in canon()
+_P_HI_LIMBS = jnp.asarray(to_limbs(P << BITS), jnp.int32)
+# Positivity shift: C = p * 2^14 ~ 2^395.8 exceeds the |value| bound of a
+# fold-round output (~2^395.4), so after canon's entry fold, x + C is
+# nonnegative and no signed-carry absorption rounds are needed.
+_C_SHIFT = jnp.asarray(to_limbs(P << 14), jnp.int32)
 
 ZERO = jnp.zeros((W,), jnp.int32)
 ONE = jnp.asarray(to_limbs(1), jnp.int32)
@@ -93,54 +106,42 @@ def carry_round(x: jnp.ndarray) -> jnp.ndarray:
 
 
 def carry3(x: jnp.ndarray) -> jnp.ndarray:
-    """Three parallel rounds: |entries| < 2^31 -> limbs in [-1, 2^13].
-    (Bound walk: 2^31 -> 2^13+2^18 -> 2^13+2^5+1 -> 2^13+1 -> final l+h with
+    """Three parallel rounds: |entries| < 2^31 -> limbs in [-1, 2^12].
+    (Bound walk: 2^31 -> 2^12+2^19 -> 2^12+2^7+1 -> 2^12+1 -> final l+h with
     h in [-1,1]; symmetric for negatives.)"""
     return carry_round(carry_round(carry_round(x)))
 
 
 def _fold_round(x: jnp.ndarray) -> jnp.ndarray:
-    """Contract limbs above NLIMBS with FOLD_R and carry. Preserves value
-    mod p; shrinks |value| toward 2^390 by ~2^8.7 per round. Output width
-    input+3-ish, limbs in [-1, 2^13]."""
+    """Contract limbs above NLIMBS with FOLD_R and carry: ONE einsum.
+    Preserves value mod p. Output width exactly W = NLIMBS + 3, limbs in
+    [-1, 2^12]; |value| <= 2^384 + (#rows) * 2^12 * p < 2^399.5, and
+    >= -(#rows * p + 2^384) > -2^390."""
     lo = x[..., :NLIMBS]
     hi = x[..., NLIMBS:]
     k = hi.shape[-1]
     assert k <= _N_FOLD_ROWS
-    acc = lo
-    for s in range(0, k, _FOLD_CHUNK):
-        chunk = hi[..., s : s + _FOLD_CHUNK]
-        acc = acc + jnp.einsum(
-            "...j,jk->...k",
-            chunk,
-            FOLD_R[s : s + chunk.shape[-1], :NLIMBS],
-            preferred_element_type=jnp.int32,
-        )
-        if s + _FOLD_CHUNK < k:
-            # carry before the next chunk so the accumulator stays < 2^31
-            y = carry3(acc)
-            extra = y[..., NLIMBS:]
-            acc = y[..., :NLIMBS] + jnp.einsum(
-                "...j,jk->...k",
-                extra,
-                FOLD_R[: extra.shape[-1], :NLIMBS],
-                preferred_element_type=jnp.int32,
-            )
+    acc = lo + jnp.einsum(
+        "...j,jk->...k",
+        hi,
+        FOLD_R[:k, :NLIMBS],
+        preferred_element_type=jnp.int32,
+    )
     return carry3(acc)
 
 
 def _truncate(x: jnp.ndarray) -> jnp.ndarray:
-    """Drop limbs above W. Valid when |value| << 2^403 - 2^379 (callers
-    guarantee |value| < 2^400): the dropped limbs are provably zero."""
+    """Drop limbs at index >= W. After a fold round this is the identity
+    (output width is exactly W); after carry3 of a width-W vector it drops
+    limbs of weight >= 2^420, provably zero for |value| < 2^408."""
     return x[..., :W]
 
 
 def reduce_columns(cols: jnp.ndarray) -> jnp.ndarray:
     """Signed product columns (width <= 2W-1, |entries| < 2^31) -> lazy
-    limbs (..., W), |value| < 2^392, congruent mod p."""
-    x = carry3(cols)  # width <= 2W+2, limbs in [-1, 2^13]
-    # |v|: < 2^806 -> fold -> < 34*2^13*p ~ 2^399.8 -> < 2^391.8 -> < 2^390.2
-    x = _fold_round(x)
+    limbs (..., W), |value| < 2^396, congruent mod p."""
+    x = carry3(cols)  # width <= 2W+2, limbs in [-1, 2^12]
+    # |v| < 2^845 -> fold -> < 2^399.5 -> fold -> < 2^384 + 3*2^12*p < 2^396
     x = _fold_round(x)
     x = _fold_round(x)
     return _truncate(x)
@@ -156,7 +157,9 @@ TOEP_IDX = jnp.asarray(_TOEP_IDX, jnp.int32)
 def mul_columns(a: jnp.ndarray, b: jnp.ndarray) -> jnp.ndarray:
     """Schoolbook product columns: (..., W) x (..., W) -> (..., 2W-1), as a
     Toeplitz-gather + batched matvec (XLA: one gather + one dot_general).
-    Requires the lazy limb invariant (limbs in [-1, 2^13]) on both inputs."""
+    Requires the lazy limb invariant (limbs in [-1, 2^12]) on both inputs.
+    Column entries are < 2^29.2: up to three column vectors may be combined
+    additively before one shared `reduce_columns`."""
     a, b = jnp.broadcast_arrays(a, b)
     a_pad = _pad_last(a, W, W - 1)  # a_pad[j] = a[j - W]
     t = a_pad[..., TOEP_IDX]  # (..., 2W-1, W)
@@ -173,28 +176,34 @@ def sq(a: jnp.ndarray) -> jnp.ndarray:
 
 
 def _norm(x: jnp.ndarray) -> jnp.ndarray:
-    """Renormalize small-column results (|entries| < 2^31, |value| < 2^398)
+    """Renormalize small-column results (|entries| < 2^31, |value| < 2^399)
     back to the lazy invariant."""
     x = carry3(x)
     x = _fold_round(x)
     return _truncate(x)
 
 
+# Add/sub/neg skip the pre-carry: raw sums of lazy vectors have entries in
+# [-2^13, 2^13], so the fold's guard-limb contraction (entries up to
+# 3 * 2^13 * 2^12 + 2^13 < 2^27) stays far under int32 and one fold round
+# IS the whole normalization -- einsum + carry3, no carry3-before-fold.
+
+
 def add(a: jnp.ndarray, b: jnp.ndarray) -> jnp.ndarray:
-    return _norm(a + b)
+    return _fold_round(a + b)
 
 
 def sub(a: jnp.ndarray, b: jnp.ndarray) -> jnp.ndarray:
-    return _norm(a - b)
+    return _fold_round(a - b)
 
 
 def neg(a: jnp.ndarray) -> jnp.ndarray:
-    return _norm(-a)
+    return _fold_round(-a)
 
 
 def mul_small(a: jnp.ndarray, k: int) -> jnp.ndarray:
-    """Multiply by a small integer constant, |k| <= 64 (keeps |value| < 2^398,
-    the _norm precondition)."""
+    """Multiply by a small integer constant, |k| <= 64 (keeps |value| < 2^403
+    for the fold precondition; entries < 64 * 2^12 < 2^31 for the carry)."""
     assert abs(k) <= 64
     return _norm(a * jnp.int32(k))
 
@@ -221,7 +230,7 @@ def select(cond: jnp.ndarray, a: jnp.ndarray, b: jnp.ndarray) -> jnp.ndarray:
 
 
 def _scan_carry(x: jnp.ndarray):
-    """Exact sequential carry: -> (limbs in [0, 2^13), signed carry_out)."""
+    """Exact sequential carry: -> (limbs in [0, 2^BITS), signed carry_out)."""
     xs = jnp.moveaxis(x, -1, 0)
 
     def body(c, limb):
@@ -234,51 +243,56 @@ def _scan_carry(x: jnp.ndarray):
 
 
 def _geq(x: jnp.ndarray, m: jnp.ndarray) -> jnp.ndarray:
-    """Lexicographic x >= m for canonical limb vectors in [0, 2^13)."""
-    gt = jnp.zeros(x.shape[:-1], bool)
-    lt = jnp.zeros(x.shape[:-1], bool)
-    for i in reversed(range(x.shape[-1])):
-        xi, mi = x[..., i], m[i]
-        gt = gt | (~lt & (xi > mi))
-        lt = lt | (~gt & (xi < mi))
-    return ~lt
+    """Lexicographic x >= m for canonical limb vectors in [0, 2^BITS),
+    vectorized: find the most-significant differing limb and compare there
+    (equal vectors leave an all-zero diff and report True)."""
+    diff = x - m
+    nz = diff != 0
+    w = x.shape[-1]
+    msd = (w - 1) - jnp.argmax(nz[..., ::-1], axis=-1)
+    top = jnp.take_along_axis(diff, msd[..., None], axis=-1)[..., 0]
+    return top >= 0
 
 
-# Barrett: quotient q = floor(v / p) < 2^22 for v < 2^403; f32 estimate from
-# the top three limbs (weight 2^364) is within +-2 of q.
-_BARRETT_TOP = BITS * 28
-_BARRETT_INV = np.float32((2.0**_BARRETT_TOP) / float(P))
+# Barrett: after the entry fold and positivity shift, v < 2^396.7; the
+# quotient q = floor(v / p) < 2^15. A float32 estimate from the top five
+# limbs (weight 2^360) carries absolute error well under 1, so q_est - 1
+# is a guaranteed under-estimate within 2 of q.
+_BARRETT_TOP_LIMB = 30
+_BARRETT_INV = np.float32((2.0 ** (BITS * _BARRETT_TOP_LIMB)) / float(P))
+
+# Multiples-of-p table for canon's final step: the Barrett remainder lies
+# in [0, 3p), so subtracting KP[cnt] lands exactly in [0, p).
+_KP = jnp.asarray(np.stack([to_limbs(k * P) for k in range(3)]), jnp.int32)
 
 
 def canon(x: jnp.ndarray) -> jnp.ndarray:
-    """Exact canonical representative in [0, p), width W (guard limb zero).
-    Input: lazy limbs, |value| < 2^399. Boundary use only (lax.scan inside)."""
+    """Exact canonical representative in [0, p), width W (guard limbs zero).
+    Input: ANY lazy limb vector (limbs in [-1, 2^BITS], width W). Boundary
+    use only (lax.scan inside)."""
     assert x.shape[-1] == W
-    # absorb the signed carry-out: 2^403 mod p has fold row index W - NLIMBS
-    r_top = FOLD_R[W - NLIMBS, :W]
-    for _ in range(2):
-        l, c = _scan_carry(x)
-        x = l + c[..., None] * r_top
-    l, _ = _scan_carry(x)  # value now in [0, 2^403), carry-out zero
+    # Entry fold: contracts any lazy value (|v| < 2^408.2) to |v| < 2^395.4.
+    x = _truncate(_fold_round(x))
+    # Shift positive: C = p * 2^14 > 2^395.4 >= |value|, congruent mod p.
+    x = x + _C_SHIFT
+    l, _ = _scan_carry(x)  # value in [0, 2^396.7): carry-out zero
     x = l
-    v_top = (
-        x[..., 30].astype(jnp.float32) * np.float32(1 << 26)
-        + x[..., 29].astype(jnp.float32) * np.float32(1 << 13)
-        + x[..., 28].astype(jnp.float32)
-    )
+    v_top = jnp.zeros(x.shape[:-1], jnp.float32)
+    for i in range(W - 1, _BARRETT_TOP_LIMB - 1, -1):
+        v_top = v_top * np.float32(BASE) + x[..., i].astype(jnp.float32)
     q = jnp.floor(v_top * _BARRETT_INV).astype(jnp.int32)
-    q = jnp.maximum(q - 2, 0)  # clamp to a guaranteed under-estimate
-    # split q = q_hi * 2^11 + q_lo so limb products stay < 2^25
-    q_lo = q & 0x7FF
-    q_hi = jnp.right_shift(q, 11)
-    x = x - q_lo[..., None] * P_LIMBS - q_hi[..., None] * _P11_LIMBS
-    l, _ = _scan_carry(x)  # remainder in [0, 5p): carry-out zero
+    q = jnp.maximum(q - 1, 0)  # clamp to a guaranteed under-estimate
+    # split q = q_hi * 2^BITS + q_lo so limb products stay < 2^24
+    q_lo = q & MASK
+    q_hi = jnp.right_shift(q, BITS)
+    x = x - q_lo[..., None] * P_LIMBS - q_hi[..., None] * _P_HI_LIMBS
+    l, _ = _scan_carry(x)  # remainder in [0, 3p): carry-out zero
     x = l
-    for _ in range(4):  # at most four conditional subtractions
-        ge = _geq(x, P_LIMBS)
-        x = jnp.where(ge[..., None], x - P_LIMBS, x)
-        x, _ = _scan_carry(x)
-    return x
+    # one table-indexed subtraction instead of conditional-subtract rounds
+    cnt = _geq(x, _KP[1]).astype(jnp.int32) + _geq(x, _KP[2]).astype(jnp.int32)
+    x = x - _KP[cnt]
+    l, _ = _scan_carry(x)
+    return l
 
 
 def eq(a: jnp.ndarray, b: jnp.ndarray) -> jnp.ndarray:

@@ -4,26 +4,36 @@ Replaces blst's miller_loop_n / final_exp (reached from reference
 crypto/bls/src/impls/blst.rs:114-116 `verify_multiple_aggregate_signatures`)
 with TPU-shaped kernels:
 
-  * Miller loop accumulators stay in Jacobian coordinates; line evaluations
-    use denominator-cleared formulas (no field inversion anywhere in the
-    loop). Each line is scaled by a nonzero Fp2 factor, which the easy part
-    of the final exponentiation annihilates (c^(p^6-1) = 1 for c in Fp2) --
-    the same trick the oracle documents in pairing_ref.py.
-  * The loop over the BLS parameter |x| = 0xd201000000010000 (6 set bits) is
-    segmented: runs of doubling steps run under `lax.scan` (compact program),
-    the 5 addition steps are unrolled at their exact bit positions -- no
-    wasted add-step work, unlike a naive scan-with-select ladder.
+  * The Miller accumulator T is kept in JACOBIAN coordinates with dedicated
+    exception-free step formulas private to this module (the general group
+    law in curve.py is complete-projective; the ladder here never hits the
+    exceptional cases: T = [j]Q with 2 <= j < |x| << r, so T == +-Q or
+    T == O are impossible for r-torsion Q, and Q == O is masked by the
+    final select to f = 1 -- garbage limbs flow harmlessly).
+  * Line evaluations use denominator-cleared formulas (no field inversion
+    anywhere in the loop). Each line is scaled by a nonzero Fp2 factor,
+    which the easy part of the final exponentiation annihilates
+    (c^(p^6-1) = 1 for c in Fp2) -- the same trick the oracle documents in
+    pairing_ref.py.
+  * The loop over the BLS parameter |x| = 0xd201000000010000 runs as ONE
+    `lax.scan` over the 63 post-leading bits; the 5 addition steps execute
+    under `lax.cond` on the (scalar, compile-time-scanned) bit, so the
+    compiled program contains ONE doubling body and ONE addition body
+    total, and the addition branch is actually skipped at runtime on the
+    58 zero bits (XLA conditionals on scalar predicates are real branches).
   * Lines are sparse Fp12 elements (3 nonzero Fp2 slots); f <- f^2 * line
     uses a Karatsuba sparse multiply (15 Fp2 muls vs 18 for a dense mul).
   * Final exponentiation: easy part by conjugate/inverse/Frobenius; hard
     part via the x-addition-chain identity
         3 * (p^4 - p^2 + 1)/r = (x-1)^2 * (x+p) * (x^2 + p^2 - 1) + 3,
-    verified as an integer identity at import time. Computing f^(3h) instead
-    of f^h is sound for verification: gcd(3, r) = 1, so f^(3h) == 1 iff
-    f^h == 1. Cost: 5 64-bit cyclotomic pows instead of a 1200-bit pow.
-  * Everything is shape-polymorphic over leading batch axes; a pairing
-    product reduces with a log-depth tree of Fp12 muls, then ONE shared
-    final exponentiation (the blst batch-verify structure).
+    verified as an integer identity at import time. Computing f^(3h)
+    instead of f^h is sound for verification: gcd(3, r) = 1, so
+    f^(3h) == 1 iff f^h == 1. The five f^|x| ladders run as ONE nested
+    scan (outer: 5 chain steps with a selected multiplier, inner: the
+    64-bit pow scan), so program size is one pow body -- not five.
+  * A pairing product reduces with `fp12_prod` -- a halving reduction in
+    one scanned body -- then ONE shared final exponentiation (the blst
+    batch-verify structure).
 
 Differentially tested against pairing_ref.py in tests/test_tpu_pairing.py.
 """
@@ -36,7 +46,6 @@ import jax
 import jax.numpy as jnp
 
 from ..constants import BLS_X, P, R
-from . import curve as C
 from . import limbs as L
 from . import tower as T
 
@@ -85,6 +94,48 @@ def mul_by_line(f, line):
     return jnp.stack([r0, r1], axis=-4)
 
 
+# --- Jacobian accumulator steps (private, exception-free) -------------------
+
+
+def _jac_double(t):
+    """dbl-2009-l on Fp2 Jacobian coords; exception-free for a = 0."""
+    x, y, z = t[..., 0, :, :], t[..., 1, :, :], t[..., 2, :, :]
+    a = T.fp2_sq(x)
+    b = T.fp2_sq(y)
+    c = T.fp2_sq(b)
+    d = T.fp2_mul_small(
+        T.fp2_sub(T.fp2_sub(T.fp2_sq(T.fp2_add(x, b)), a), c), 2
+    )
+    e = T.fp2_mul_small(a, 3)
+    f = T.fp2_sq(e)
+    x3 = T.fp2_sub(f, T.fp2_mul_small(d, 2))
+    y3 = T.fp2_sub(T.fp2_mul(e, T.fp2_sub(d, x3)), T.fp2_mul_small(c, 8))
+    z3 = T.fp2_mul(T.fp2_mul_small(y, 2), z)
+    return jnp.stack([x3, y3, z3], axis=-3)
+
+
+def _jac_madd(t, q_aff):
+    """madd-2007-bl (Jacobian += affine) WITHOUT exceptional-case handling:
+    sound in the Miller ladder where T = [j]Q, 2 <= j, j -+ 1 != 0 mod r."""
+    x1, y1, z1 = t[..., 0, :, :], t[..., 1, :, :], t[..., 2, :, :]
+    x2, y2 = q_aff[..., 0, :, :], q_aff[..., 1, :, :]
+    z1z1 = T.fp2_sq(z1)
+    u2 = T.fp2_mul(x2, z1z1)
+    s2 = T.fp2_mul(T.fp2_mul(y2, z1), z1z1)
+    h = T.fp2_sub(u2, x1)
+    hh = T.fp2_sq(h)
+    i = T.fp2_mul_small(hh, 4)
+    j = T.fp2_mul(h, i)
+    r = T.fp2_mul_small(T.fp2_sub(s2, y1), 2)
+    v = T.fp2_mul(x1, i)
+    x3 = T.fp2_sub(T.fp2_sub(T.fp2_sq(r), j), T.fp2_mul_small(v, 2))
+    y3 = T.fp2_sub(
+        T.fp2_mul(r, T.fp2_sub(v, x3)), T.fp2_mul_small(T.fp2_mul(y1, j), 2)
+    )
+    z3 = T.fp2_sub(T.fp2_sub(T.fp2_sq(T.fp2_add(z1, h)), z1z1), hh)
+    return jnp.stack([x3, y3, z3], axis=-3)
+
+
 # --- Miller loop steps ------------------------------------------------------
 
 
@@ -100,7 +151,7 @@ def _dbl_step(t, xp, yp):
     c0 = T.fp2_sub(T.fp2_mul_small(x3, 3), T.fp2_mul_small(y2, 2))
     cv = T.fp2_mul_fp(T.fp2_mul_small(T.fp2_mul(x2, z2), -3), xp)
     cvw = T.fp2_mul_fp(T.fp2_mul_small(T.fp2_mul(y, z3), 2), yp)
-    return C.double(t, C.FP2), (c0, cv, cvw)
+    return _jac_double(t), (c0, cv, cvw)
 
 
 def _add_step(t, q_aff, xp, yp):
@@ -115,8 +166,12 @@ def _add_step(t, q_aff, xp, yp):
     c0 = T.fp2_sub(T.fp2_mul(n, xq), T.fp2_mul(d, yq))
     cv = T.fp2_neg(T.fp2_mul_fp(n, xp))
     cvw = T.fp2_mul_fp(d, yp)
-    q_inf = jnp.zeros(t.shape[: t.ndim - 4], bool)
-    return C.add_mixed(t, q_aff, q_inf, C.FP2), (c0, cv, cvw)
+    return _jac_madd(t, q_aff), (c0, cv, cvw)
+
+
+_BIT_TABLE = jnp.asarray(
+    np.array([b == "1" for b in _X_BITS[1:]], np.bool_)
+)  # 63 post-leading bits, 5 ones
 
 
 def miller_loop(p_aff, p_inf, q_aff, q_inf):
@@ -124,32 +179,32 @@ def miller_loop(p_aff, p_inf, q_aff, q_inf):
 
     p_aff: (..., 2, W) affine G1; q_aff: (..., 2, 2, W) affine G2; *_inf are
     (...,) bool masks. Infinite inputs yield the neutral one (matching the
-    oracle and blst's aggregate semantics).
+    oracle and blst's aggregate semantics). ONE scan over the 63 bits; the
+    add step runs under lax.cond (scalar predicate -> a real XLA branch,
+    skipped on zero bits at runtime).
     """
     xp, yp = p_aff[..., 0, :], p_aff[..., 1, :]
     batch = p_inf.shape
-    t0 = C.from_affine(q_aff, q_inf, C.FP2)
+    # Jacobian T init: (xq, yq, 1); infinity rows hold garbage that the
+    # final select masks out.
+    z0 = jnp.broadcast_to(T.fp2_one(batch), q_aff[..., 0, :, :].shape)
+    t0 = jnp.stack([q_aff[..., 0, :, :], q_aff[..., 1, :, :], z0], axis=-3)
     f0 = T.fp12_one(batch)
 
-    def dbl_body(carry, _):
+    def body(carry, bit):
         f, t = carry
-        t2, line = _dbl_step(t, xp, yp)
-        f2 = mul_by_line(T.fp12_sq(f), line)
-        return (f2, t2), None
+        t, line = _dbl_step(t, xp, yp)
+        f = mul_by_line(T.fp12_sq(f), line)
 
-    f, t = f0, t0
-    # segment the bit string after the leading 1 into (zeros-run, add) chunks
-    bits = _X_BITS[1:]
-    i = 0
-    while i < len(bits):
-        j = bits.find("1", i)
-        run = (len(bits) - i) if j < 0 else (j - i + 1)
-        (f, t), _ = jax.lax.scan(dbl_body, (f, t), None, length=run)
-        if j < 0:
-            break
-        t, line = _add_step(t, q_aff, xp, yp)
-        f = mul_by_line(f, line)
-        i = j + 1
+        def with_add(args):
+            f_, t_ = args
+            t2, line2 = _add_step(t_, q_aff, xp, yp)
+            return mul_by_line(f_, line2), t2
+
+        f, t = jax.lax.cond(bit, with_add, lambda args: args, (f, t))
+        return (f, t), None
+
+    (f, _), _ = jax.lax.scan(body, (f0, t0), _BIT_TABLE)
     f = T.fp12_conj(f)  # x < 0
     return T.fp12_select(p_inf | q_inf, T.fp12_one(batch), f)
 
@@ -159,59 +214,79 @@ def miller_loop(p_aff, p_inf, q_aff, q_inf):
 
 def _pow_x_abs(f):
     """f^|x| in the cyclotomic subgroup, as ONE compact lax.scan over the
-    compile-time bit pattern (program size ~ 1 square + 1 multiply; the 5
-    call sites in the final exponentiation would otherwise inline ~340 Fp12
-    ops of HLO). The selected-away multiplies cost ~1.7x runtime on an op
-    that runs once per batch -- the right trade for compile size."""
-    bits = jnp.asarray(np.array([b == "1" for b in _X_BITS[1:]], np.bool_))
-
+    compile-time bit pattern (program size ~ 1 square + 1 multiply)."""
     def body(acc, bit):
         acc = T.fp12_sq(acc)
         return T.fp12_select(bit, T.fp12_mul(acc, f), acc), None
 
-    out, _ = jax.lax.scan(body, f, bits)
+    out, _ = jax.lax.scan(body, f, _BIT_TABLE)
     return out
-
-
-def _pow_x(f):
-    """f^x for the (negative) BLS parameter: conj is cyclotomic inverse."""
-    return T.fp12_conj(_pow_x_abs(f))
 
 
 def final_exponentiation(f):
     """f^(3 * (p^12-1)/r): easy part exactly, hard part via the x-chain.
-    The extra cube is verification-neutral (see module docstring)."""
+    The extra cube is verification-neutral (see module docstring).
+
+    Hard part as one nested scan. With s_0 = f (cyclotomic after the easy
+    part), step i computes s_{i+1} = s_i^x * m_i with multiplier
+    m_i = conj(s_i) (i = 0, 1), frobenius(s_i) (i = 2), one (i = 3, 4):
+      s_1 = f^(x-1), s_2 = f^((x-1)^2), s_3 = s_2^(x+p) =: a,
+      s_4 = a^x, s_5 = a^(x^2),
+    and the result is s_5 * frob^2(a) * conj(a) * f^3.
+    """
     # easy: f^(p^6 - 1), then ^(p^2 + 1). Afterwards f is cyclotomic:
     # inverse == conjugate.
     f = T.fp12_mul(T.fp12_conj(f), T.fp12_inv(f))
     f = T.fp12_mul(T.fp12_frobenius_n(f, 2), f)
-    # hard: f^((x-1)^2 * (x+p) * (x^2+p^2-1)) * f^3
-    a = T.fp12_mul(_pow_x(f), T.fp12_conj(f))  # f^(x-1)
-    a = T.fp12_mul(_pow_x(a), T.fp12_conj(a))  # f^((x-1)^2)
-    a = T.fp12_mul(_pow_x(a), T.fp12_frobenius(a))  # ^(x+p)
-    a2 = _pow_x(_pow_x(a))  # a^(x^2)
-    a = T.fp12_mul(
-        T.fp12_mul(a2, T.fp12_frobenius_n(a, 2)), T.fp12_conj(a)
-    )  # ^(x^2+p^2-1)
-    f3 = T.fp12_mul(T.fp12_sq(f), f)
-    return T.fp12_mul(a, f3)
+
+    def body(carry, i):
+        s, a_saved = carry
+        t = T.fp12_conj(_pow_x_abs(s))  # s^x (x < 0)
+        frob = T.fp12_frobenius(s)
+        m = T.fp12_select(
+            jnp.asarray(i < 2),
+            T.fp12_conj(s),
+            T.fp12_select(jnp.asarray(i == 2), frob, T.fp12_one(s.shape[:-4])),
+        )
+        s_next = T.fp12_mul(t, m)
+        a_saved = T.fp12_select(jnp.asarray(i == 2), s_next, a_saved)
+        return (s_next, a_saved), None
+
+    (s, a), _ = jax.lax.scan(body, (f, f), jnp.arange(5))
+    # final combine s * frob^2(a) * conj(a) * f^2 * f as one scanned product
+    factors = jnp.stack(
+        [s, T.fp12_frobenius_n(a, 2), T.fp12_conj(a), T.fp12_sq(f), f], axis=0
+    )
+    return fp12_prod(factors, axis=0)
 
 
 # --- products & pairings ----------------------------------------------------
 
 
 def fp12_prod(f, axis: int = 0):
-    """Product along `axis` by log-depth halving (tree of Fp12 muls)."""
+    """Product along `axis`: pad to a power of two with ones, then a
+    halving reduction as ONE scanned body (adjacent pairs multiply into the
+    front half; the back half refills with ones)."""
     f = jnp.moveaxis(f, axis, 0)
     n = f.shape[0]
-    while n > 1:
-        half = n // 2
-        lo = f[:half]
-        hi = f[half : 2 * half]
-        rest = f[2 * half :]
-        f = jnp.concatenate([T.fp12_mul(lo, hi), rest], axis=0)
-        n = f.shape[0]
-    return f[0]
+    if n == 1:
+        return f[0]
+    m = 1
+    while m < n:
+        m *= 2
+    ones = T.fp12_one((m - n,) + f.shape[1:-4]) if m > n else None
+    if ones is not None:
+        f = jnp.concatenate([f, ones], axis=0)
+    half = m // 2
+    pad = T.fp12_one((half,) + f.shape[1:-4])
+    steps = m.bit_length() - 1
+
+    def body(acc, _):
+        s = T.fp12_mul(acc[0::2], acc[1::2])
+        return jnp.concatenate([s, pad], axis=0), None
+
+    out, _ = jax.lax.scan(body, f, None, length=steps)
+    return out[0]
 
 
 def pairing(p_aff, p_inf, q_aff, q_inf):
@@ -222,7 +297,7 @@ def pairing(p_aff, p_inf, q_aff, q_inf):
 
 def multi_pairing(p_aff, p_inf, q_aff, q_inf):
     """prod_i e(P_i, Q_i)^3 over the leading batch axis: batched Miller
-    loops, tree product, ONE final exponentiation (blst.rs:114-116)."""
+    loops, halving-scan product, ONE final exponentiation (blst.rs:114-116)."""
     f = miller_loop(p_aff, p_inf, q_aff, q_inf)
     return final_exponentiation(fp12_prod(f, axis=0))
 

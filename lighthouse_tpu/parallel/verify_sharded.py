@@ -18,7 +18,7 @@ from __future__ import annotations
 import jax
 import jax.numpy as jnp
 from jax.sharding import Mesh, PartitionSpec as P
-from jax.experimental.shard_map import shard_map
+from jax import shard_map
 
 from ..crypto.bls.backends.jax_tpu import verify_body
 
@@ -49,6 +49,6 @@ def make_sharded_verify(mesh: Mesh):
         mesh=mesh,
         in_specs=(spec, spec, spec, spec, spec),
         out_specs=rep,
-        check_rep=False,
+        check_vma=False,
     )
     return jax.jit(body)

@@ -54,8 +54,8 @@ class TestCarryAndReduce:
 
         y = np.asarray(j_carry3(jnp.asarray(x, jnp.int32)))
         for i in range(64):
-            assert L.to_int(y[i]) == sum(int(c) << (13 * j) for j, c in enumerate(x[i]))
-        assert y.min() >= -1 and y.max() <= (1 << 13)
+            assert L.to_int(y[i]) == sum(int(c) << (L.BITS * j) for j, c in enumerate(x[i]))
+        assert y.min() >= -1 and y.max() <= L.BASE
 
     def test_reduce_columns_adversarial(self):
         import jax.numpy as jnp
@@ -66,10 +66,10 @@ class TestCarryAndReduce:
             RNG.integers(-(2**31) + 1, 2**31 - 1, size=(2 * L.W - 1,), dtype=np.int64),
         ]
         for c in cases:
-            val = sum(int(x) << (13 * j) for j, x in enumerate(c))
+            val = sum(int(x) << (L.BITS * j) for j, x in enumerate(c))
             out = np.asarray(j_reduce(jnp.asarray(c[None], jnp.int32)))[0]
-            assert out.min() >= -1 and out.max() <= (1 << 13)
-            assert abs(L.to_int(out)) < 2**392
+            assert out.min() >= -1 and out.max() <= L.BASE
+            assert abs(L.to_int(out)) < 2**396
             assert L.to_int(out) % P == val % P
 
     def test_canon_matches_bigint(self):
@@ -82,11 +82,11 @@ class TestCarryAndReduce:
     def test_canon_negative_and_lazy(self):
         import jax.numpy as jnp
 
-        # lazy vectors with negative limbs: value = sum limb_i 2^13i
-        x = RNG.integers(-1, (1 << 13) + 1, size=(32, L.W), dtype=np.int64)
+        # lazy vectors with negative limbs: value = sum limb_i 2^(BITS i)
+        x = RNG.integers(-1, L.BASE + 1, size=(32, L.W), dtype=np.int64)
         out = np.asarray(j_canon(jnp.asarray(x, jnp.int32)))
         for i in range(32):
-            val = sum(int(c) << (13 * j) for j, c in enumerate(x[i]))
+            val = sum(int(c) << (L.BITS * j) for j, c in enumerate(x[i]))
             assert L.to_int(out[i]) == val % P
 
 
@@ -108,7 +108,7 @@ class TestFieldOps:
         for _ in range(10):
             acc = L.mul(acc, a)
             arr = np.asarray(acc)
-            assert arr.min() >= -1 and arr.max() <= (1 << 13)
+            assert arr.min() >= -1 and arr.max() <= L.BASE
             expect = [(e * v) % P for e, v in zip(expect, vals)]
         out = np.asarray(j_canon(acc))
         for i, e in enumerate(expect):
@@ -128,10 +128,10 @@ class TestFieldOps:
     def test_addsub_on_lazy_extremes(self):
         import jax.numpy as jnp
 
-        x = np.full((4, L.W), (1 << 13), np.int64)
+        x = np.full((4, L.W), L.BASE, np.int64)
         y = np.full((4, L.W), -1, np.int64)
-        vx = sum(1 << (13 * j + 13) for j in range(L.W))
-        vy = -sum(1 << (13 * j) for j in range(L.W))
+        vx = sum(1 << (L.BITS * j + L.BITS) for j in range(L.W))
+        vy = -sum(1 << (L.BITS * j) for j in range(L.W))
         out = np.asarray(j_canon(L.add(jnp.asarray(x, jnp.int32), jnp.asarray(y, jnp.int32))))
         assert L.to_int(out[0]) == (vx + vy) % P
 

@@ -77,7 +77,13 @@ class BeaconChain:
         from ..fork_choice.fork_choice import _justified_balances
 
         self.fork_choice = ForkChoice(
-            preset, spec, genesis_state.slot, genesis_root, jc, fc
+            preset,
+            spec,
+            genesis_state.slot,
+            genesis_root,
+            jc,
+            fc,
+            state_lookup=lambda root: self._states.get(root),
         )
         self.fork_choice.justified_balances = _justified_balances(
             genesis_state, preset
@@ -90,6 +96,9 @@ class BeaconChain:
         self.head_root = genesis_root
         self.head_state = clone_state(genesis_state)
         self._states: dict[bytes, object] = {genesis_root: genesis_state}
+        # optional engine handle (reference beacon_chain.execution_layer);
+        # None = pre-merge / no EL configured
+        self.execution_layer = None
         # SSE event subscribers (events.rs): fn(kind: str, payload: dict)
         self.event_sinks: list = []
 
@@ -133,6 +142,16 @@ class BeaconChain:
         state = clone_state(parent_state)
         state = process_slots(state, block.slot, self.preset, self.spec)
         ctxt = ConsensusContext(self.preset, self.spec)
+        if self.execution_layer is not None:
+            # engine round trip runs INSIDE process_execution_payload (spec
+            # order: after the parent-hash/randao/timestamp checks); the
+            # hook records the verdict on the context for fork choice.
+            def _notify(payload, _ctxt=ctxt):
+                status = self.execution_layer.notify_new_payload(payload)
+                _ctxt.payload_verification_status = status
+                return True
+
+            ctxt.notify_new_payload = _notify
         try:
             per_block_processing(
                 state,
@@ -144,6 +163,27 @@ class BeaconChain:
             )
         except BlockProcessingError as e:
             raise BlockError(str(e)) from None
+        except Exception as e:
+            from ..execution_layer import PayloadInvalid
+
+            if isinstance(e, PayloadInvalid):
+                raise BlockError(f"invalid execution payload: {e}") from None
+            raise
+
+        execution_status = "irrelevant"
+        execution_block_hash = b""
+        if ctxt.payload_verification_status is not None:
+            from ..execution_layer import PayloadVerificationStatus
+
+            execution_block_hash = bytes(
+                block.body.execution_payload.block_hash
+            )
+            execution_status = (
+                "valid"
+                if ctxt.payload_verification_status
+                is PayloadVerificationStatus.VERIFIED
+                else "optimistic"
+            )
         state_root = state.tree_hash_root()
         if bytes(block.state_root) != state_root:
             raise BlockError("block state_root mismatch")
@@ -152,7 +192,13 @@ class BeaconChain:
         self.store.put_state(state_root, state)
         self._states[block_root] = state
 
-        self.fork_choice.on_block(signed_block, block_root, state)
+        self.fork_choice.on_block(
+            signed_block,
+            block_root,
+            state,
+            execution_status=execution_status,
+            execution_block_hash=execution_block_hash,
+        )
         # fork-choice also counts the block's attestations
         for att in block.body.attestations:
             indexed = ctxt.get_indexed_attestation(state, att)
@@ -196,11 +242,35 @@ class BeaconChain:
         head = self.fork_choice.get_head()
         if head != self.head_root:
             self.head_root = head
-            self.head_state = self._states[head]
+            # Clone: callers advance/mutate the head state (block production,
+            # duty lookahead); aliasing the cached post-state would corrupt
+            # the canonical chain (reference snapshots in canonical_head.rs).
+            self.head_state = clone_state(self._states[head])
         return head
 
     def head(self):
         return self.head_root, self.head_state
+
+    # -- optimistic sync / payload invalidation (fork_revert.rs analogue) ---
+
+    def on_invalid_payload(
+        self, block_root: bytes, latest_valid_hash: bytes | None = None
+    ) -> bytes:
+        """The engine ruled an optimistically-imported payload INVALID
+        (e.g. via a later forkchoiceUpdated): poison the subtree in fork
+        choice and recompute the head away from it."""
+        self.fork_choice.on_invalid_execution_payload(
+            block_root, latest_valid_hash
+        )
+        head = self.recompute_head()
+        self.emit(
+            "invalid_payload",
+            {"block": "0x" + bytes(block_root).hex(), "new_head": "0x" + head.hex()},
+        )
+        return head
+
+    def is_optimistic(self, block_root: bytes) -> bool:
+        return self.fork_choice.is_optimistic(block_root)
 
     @property
     def finalized_checkpoint(self):

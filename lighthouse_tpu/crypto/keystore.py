@@ -190,11 +190,23 @@ class Keystore:
             raise KeystoreError(f"unsupported kdf {kdf['function']}")
         cipher_message = bytes.fromhex(crypto["cipher"]["message"])
         checksum = hashlib.sha256(dk[16:32] + cipher_message).digest()
-        if checksum.hex() != crypto["checksum"]["message"]:
+        # constant-time compare: a timing oracle on the checksum would leak
+        # password-correctness bytewise (reference uses fixed-time eq)
+        if not hmac.compare_digest(
+            checksum, bytes.fromhex(crypto["checksum"]["message"])
+        ):
             raise KeystoreError("incorrect password (checksum mismatch)")
         iv = bytes.fromhex(crypto["cipher"]["params"]["iv"])
         secret = aes128_ctr(dk[:16], iv, cipher_message)
-        return SecretKey.from_bytes(secret)
+        sk = SecretKey.from_bytes(secret)
+        # verify the decrypted secret against the stored pubkey: a corrupted
+        # keystore must not hand back a mismatched signing key
+        stored_pk = self.payload.get("pubkey")
+        if stored_pk:
+            normalized = stored_pk.removeprefix("0x").lower()
+            if sk.public_key().to_bytes().hex() != normalized:
+                raise KeystoreError("decrypted secret does not match pubkey")
+        return sk
 
     @property
     def pubkey(self) -> str:

@@ -18,11 +18,12 @@ class ForkChoiceError(ValueError):
     pass
 
 
-def _justified_balances(state, preset) -> list[int]:
+def _justified_balances(state, preset, epoch: int | None = None) -> list[int]:
     """Spec fork-choice weights: EFFECTIVE balances of validators active at
-    the state's epoch; everyone else weighs zero (exited/slashed stakes
-    must not keep moving the head)."""
-    epoch = compute_epoch_at_slot(state.slot, preset)
+    the given epoch (default: the state's epoch); everyone else weighs zero
+    (exited/slashed stakes must not keep moving the head)."""
+    if epoch is None:
+        epoch = compute_epoch_at_slot(state.slot, preset)
     return [
         v.effective_balance if is_active_validator(v, epoch) else 0
         for v in state.validators
@@ -38,12 +39,19 @@ class ForkChoice:
         genesis_root: bytes,
         justified_checkpoint: tuple[int, bytes],
         finalized_checkpoint: tuple[int, bytes],
+        state_lookup=None,
     ):
         self.preset = preset
         self.spec = spec
         self.justified_checkpoint = justified_checkpoint
         self.finalized_checkpoint = finalized_checkpoint
         self.justified_balances: list[int] = []
+        # root -> post-state resolver for the justified checkpoint's state
+        # (reference: JustifiedBalances built from the justified state,
+        # fork_choice.rs / proto_array). Without it, on_block falls back to
+        # the importing block's post-state -- a spec divergence in
+        # contested forks.
+        self.state_lookup = state_lookup
         self.current_slot = genesis_slot
         self.queued_attestations: list[tuple[int, int, bytes, int]] = []
         self.proto = ProtoArrayForkChoice(
@@ -73,10 +81,19 @@ class ForkChoice:
 
     # -- blocks (fork_choice.rs:747 on_block) -------------------------------
 
-    def on_block(self, signed_block, block_root: bytes, state) -> None:
+    def on_block(
+        self,
+        signed_block,
+        block_root: bytes,
+        state,
+        execution_status: str = "irrelevant",
+        execution_block_hash: bytes = b"",
+    ) -> None:
         """`state` is the post-state of the block: its justified/finalized
         checkpoints feed the store (the reference's unrealized-justification
-        machinery reduces to this under per-block epoch processing)."""
+        machinery reduces to this under per-block epoch processing).
+        `execution_status` carries the engine verdict for optimistic-sync
+        tracking (fork_choice.rs:747's payload_verification_status)."""
         block = signed_block.message
         if block.slot > self.current_slot:
             raise ForkChoiceError("block from the future")
@@ -90,11 +107,17 @@ class ForkChoice:
         )
         if jc[0] > self.justified_checkpoint[0]:
             self.justified_checkpoint = jc
-            self.justified_balances = _justified_balances(state, self.preset)
+            self.justified_balances = self._balances_for_checkpoint(jc, state)
         if fc[0] > self.finalized_checkpoint[0]:
             self.finalized_checkpoint = fc
         self.proto.process_block(
-            block.slot, block_root, bytes(block.parent_root), jc, fc
+            block.slot,
+            block_root,
+            bytes(block.parent_root),
+            jc,
+            fc,
+            execution_status,
+            execution_block_hash,
         )
         # proposer boost: only the FIRST timely block of the slot gets it
         # (spec: set only when proposer_boost_root is empty)
@@ -104,7 +127,31 @@ class ForkChoice:
         ):
             self.proto.proposer_boost_root = block_root
         if not self.justified_balances:
-            self.justified_balances = _justified_balances(state, self.preset)
+            self.justified_balances = self._balances_for_checkpoint(
+                self.justified_checkpoint, state
+            )
+
+    def _balances_for_checkpoint(self, checkpoint, fallback_state):
+        """Weights from the JUSTIFIED checkpoint's state (reference keeps
+        JustifiedBalances from the justified state, fork_choice.rs), active
+        at the checkpoint epoch. Falls back to the importing block's
+        post-state only when the checkpoint state is unavailable."""
+        epoch, root = checkpoint
+        state = self.state_lookup(root) if self.state_lookup else None
+        if state is None:
+            state = fallback_state
+        return _justified_balances(state, self.preset, epoch)
+
+    def on_valid_execution_payload(self, root: bytes) -> None:
+        self.proto.on_valid_execution_payload(root)
+
+    def on_invalid_execution_payload(
+        self, root: bytes, latest_valid_hash: bytes | None = None
+    ) -> None:
+        self.proto.on_invalid_execution_payload(root, latest_valid_hash)
+
+    def is_optimistic(self, root: bytes) -> bool:
+        return self.proto.is_optimistic(root)
 
     # -- attestations (fork_choice.rs:1162 on_attestation) ------------------
 

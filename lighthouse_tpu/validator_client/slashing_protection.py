@@ -49,15 +49,8 @@ class SlashingDatabase:
     # -- registration --------------------------------------------------------
 
     def register_validator(self, pubkey_hex: str) -> int:
-        cur = self.conn.execute(
-            "INSERT OR IGNORE INTO validators (public_key) VALUES (?)",
-            (pubkey_hex,),
-        )
-        self.conn.commit()
-        row = self.conn.execute(
-            "SELECT id FROM validators WHERE public_key = ?", (pubkey_hex,)
-        ).fetchone()
-        return row[0]
+        with self.conn:
+            return self._register_in_txn(pubkey_hex)
 
     def _validator_id(self, pubkey_hex: str) -> int:
         row = self.conn.execute(
@@ -210,30 +203,90 @@ class SlashingDatabase:
                 raise NotSafe(
                     "interchange genesis_validators_root mismatch"
                 )
-        for record in interchange.get("data", []):
-            pubkey = record["pubkey"].removeprefix("0x")
-            vid = self.register_validator(pubkey)
-            with self.conn:
+        # All-or-nothing: every imported entry is validated against the
+        # EXISTING history (and the other imported entries) with the same
+        # double/surround rules as live signing; any slashable conflict
+        # aborts the whole import (reference: interchange import runs each
+        # record through the slashing checks, interchange.rs +
+        # slashing_database.rs import_interchange_info).
+        # `with self.conn` rolls the whole transaction back on any raise:
+        # a slashable conflict anywhere means NO partial import.
+        with self.conn:
+            for record in interchange.get("data", []):
+                pubkey = record["pubkey"].removeprefix("0x")
+                vid = self._register_in_txn(pubkey)
                 for b in record.get("signed_blocks", []):
-                    self.conn.execute(
-                        "INSERT OR IGNORE INTO signed_blocks VALUES (?, ?, ?)",
-                        (
-                            vid,
-                            int(b["slot"]),
-                            b.get("signing_root", "0x").removeprefix("0x"),
-                        ),
+                    self._import_block(
+                        vid,
+                        int(b["slot"]),
+                        b.get("signing_root", "0x").removeprefix("0x"),
                     )
                 for a in record.get("signed_attestations", []):
-                    self.conn.execute(
-                        "INSERT OR IGNORE INTO signed_attestations "
-                        "VALUES (?, ?, ?, ?)",
-                        (
-                            vid,
-                            int(a["source_epoch"]),
-                            int(a["target_epoch"]),
-                            a.get("signing_root", "0x").removeprefix("0x"),
-                        ),
+                    self._import_attestation(
+                        vid,
+                        int(a["source_epoch"]),
+                        int(a["target_epoch"]),
+                        a.get("signing_root", "0x").removeprefix("0x"),
                     )
+
+    def _register_in_txn(self, pubkey_hex: str) -> int:
+        self.conn.execute(
+            "INSERT OR IGNORE INTO validators (public_key) VALUES (?)",
+            (pubkey_hex,),
+        )
+        return self.conn.execute(
+            "SELECT id FROM validators WHERE public_key = ?", (pubkey_hex,)
+        ).fetchone()[0]
+
+    def _import_block(self, vid: int, slot: int, signing_root: str) -> None:
+        row = self.conn.execute(
+            "SELECT signing_root FROM signed_blocks "
+            "WHERE validator_id = ? AND slot = ?",
+            (vid, slot),
+        ).fetchone()
+        if row is not None:
+            if row[0] == signing_root or not row[0] or not signing_root:
+                return  # identical (or unknown-root) re-import is idempotent
+            raise NotSafe(
+                f"interchange contains a conflicting block at slot {slot}"
+            )
+        self.conn.execute(
+            "INSERT INTO signed_blocks VALUES (?, ?, ?)",
+            (vid, slot, signing_root),
+        )
+
+    def _import_attestation(
+        self, vid: int, source: int, target: int, signing_root: str
+    ) -> None:
+        if source > target:
+            raise NotSafe("interchange attestation source after target")
+        row = self.conn.execute(
+            "SELECT signing_root FROM signed_attestations "
+            "WHERE validator_id = ? AND target_epoch = ?",
+            (vid, target),
+        ).fetchone()
+        if row is not None:
+            if row[0] == signing_root or not row[0] or not signing_root:
+                return
+            raise NotSafe(
+                f"interchange contains a double vote at target {target}"
+            )
+        if self.conn.execute(
+            "SELECT 1 FROM signed_attestations WHERE validator_id = ? "
+            "AND source_epoch < ? AND target_epoch > ? LIMIT 1",
+            (vid, source, target),
+        ).fetchone():
+            raise NotSafe("interchange attestation surrounded by history")
+        if self.conn.execute(
+            "SELECT 1 FROM signed_attestations WHERE validator_id = ? "
+            "AND source_epoch > ? AND target_epoch < ? LIMIT 1",
+            (vid, source, target),
+        ).fetchone():
+            raise NotSafe("interchange attestation surrounds history")
+        self.conn.execute(
+            "INSERT INTO signed_attestations VALUES (?, ?, ?, ?)",
+            (vid, source, target, signing_root),
+        )
 
     def export_json(self, genesis_validators_root: bytes) -> str:
         return json.dumps(self.export_interchange(genesis_validators_root))

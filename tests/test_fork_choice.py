@@ -128,3 +128,87 @@ class TestVoteScenarios:
             [0] * len(fc.proto_array.nodes), (1, GENESIS), (1, GENESIS)
         )
         assert fc.proto_array.find_head(root(3)) == root(5)
+
+
+class TestJustifiedBalancesSource:
+    """Regression (round-2 review): fork-choice weights must come from the
+    JUSTIFIED checkpoint's state, not the importing block's post-state
+    (reference keeps JustifiedBalances from the justified state,
+    consensus/fork_choice/src/fork_choice.rs)."""
+
+    def _fork_choice_with_lookup(self, states):
+        from types import SimpleNamespace
+
+        from lighthouse_tpu.fork_choice.fork_choice import ForkChoice
+        from lighthouse_tpu.types import MINIMAL, ChainSpec
+
+        return ForkChoice(
+            MINIMAL,
+            ChainSpec.minimal(),
+            0,
+            GENESIS,
+            (0, GENESIS),
+            (0, GENESIS),
+            state_lookup=states.get,
+        )
+
+    def _state(self, slot, balances, jc_epoch, jc_root):
+        from types import SimpleNamespace
+
+        vals = [
+            SimpleNamespace(
+                effective_balance=b,
+                activation_epoch=0,
+                exit_epoch=2**64 - 1,
+            )
+            for b in balances
+        ]
+        cp = SimpleNamespace(epoch=jc_epoch, root=jc_root)
+        fin = SimpleNamespace(epoch=0, root=GENESIS)
+        return SimpleNamespace(
+            slot=slot,
+            validators=vals,
+            current_justified_checkpoint=cp,
+            finalized_checkpoint=fin,
+        )
+
+    def test_weights_come_from_justified_state(self):
+        from lighthouse_tpu.types import MINIMAL
+
+        jroot = root(1)
+        justified_state = self._state(8, [32, 32, 32], 0, GENESIS)
+        states = {jroot: justified_state}
+        fc = self._fork_choice_with_lookup(states)
+        # importing block's post-state claims wildly different balances and
+        # advances the justified checkpoint to (1, jroot)
+        importing = self._state(16, [999, 999, 999], 1, jroot)
+
+        block = type(
+            "B",
+            (),
+            {
+                "message": type(
+                    "M",
+                    (),
+                    {"slot": 0, "parent_root": GENESIS},
+                )()
+            },
+        )()
+        fc.on_tick(16)
+        block.message.slot = 16
+        fc.on_block(block, root(2), importing)
+        assert fc.justified_checkpoint == (1, jroot)
+        # weights taken from the justified state, NOT the importing state
+        assert fc.justified_balances == [32, 32, 32]
+
+    def test_fallback_to_importing_state_when_lookup_misses(self):
+        fc = self._fork_choice_with_lookup({})
+        importing = self._state(16, [7, 7], 1, root(9))
+        block = type(
+            "B",
+            (),
+            {"message": type("M", (), {"slot": 16, "parent_root": GENESIS})()},
+        )()
+        fc.on_tick(16)
+        fc.on_block(block, root(2), importing)
+        assert fc.justified_balances == [7, 7]

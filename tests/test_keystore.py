@@ -90,3 +90,14 @@ class TestWallet:
         w = Wallet.create("rt", "pw", seed=bytes(range(32)))
         w2 = Wallet.from_json(w.to_json())
         assert w2.unlock_seed("pw") == bytes(range(32))
+
+
+class TestDecryptIntegrity:
+    def test_tampered_pubkey_rejected(self):
+        # decrypted secret must be cross-checked against the stored pubkey
+        # (a corrupted keystore must not hand back a mismatched signing key)
+        ks = Keystore.encrypt(SecretKey(42), "pw")
+        data = json.loads(ks.to_json())
+        data["pubkey"] = SecretKey(43).public_key().to_bytes().hex()
+        with pytest.raises(KeystoreError):
+            Keystore(data).decrypt("pw")

@@ -194,3 +194,75 @@ class TestDoppelganger:
         assert vc.store._doppelganger_hold[pk1]
         vc._doppelganger_scan(2)
         assert not vc.store._doppelganger_hold[pk1]
+
+
+class TestInterchangeImportSemantics:
+    """EIP-3076 import: slashable conflicts abort the whole import
+    (reference: interchange.rs import runs every record through the
+    slashing checks; round-2 review flagged the old INSERT OR IGNORE)."""
+
+    def _db_with_history(self):
+        db = SlashingDatabase()
+        db.register_validator(PK)
+        db.check_and_insert_attestation(PK, 4, 8, b"\x01" * 32)
+        db.check_and_insert_block_proposal(PK, 100, b"\x02" * 32)
+        return db
+
+    def _interchange(self, atts=(), blocks=()):
+        return {
+            "metadata": {
+                "interchange_format_version": "5",
+                "genesis_validators_root": "0x" + "00" * 32,
+            },
+            "data": [
+                {
+                    "pubkey": "0x" + PK,
+                    "signed_blocks": [
+                        {"slot": str(s), "signing_root": "0x" + r}
+                        for s, r in blocks
+                    ],
+                    "signed_attestations": [
+                        {
+                            "source_epoch": str(s),
+                            "target_epoch": str(t),
+                            "signing_root": "0x" + r,
+                        }
+                        for s, t, r in atts
+                    ],
+                }
+            ],
+        }
+
+    def test_double_vote_in_interchange_aborts(self):
+        db = self._db_with_history()
+        bad = self._interchange(atts=[(5, 8, "aa" * 32)])  # same target, diff root
+        with pytest.raises(NotSafe):
+            db.import_interchange(bad, b"\x00" * 32)
+
+    def test_surround_in_interchange_aborts(self):
+        db = self._db_with_history()
+        bad = self._interchange(atts=[(3, 9, "bb" * 32)])  # surrounds (4, 8)
+        with pytest.raises(NotSafe):
+            db.import_interchange(bad, b"\x00" * 32)
+
+    def test_conflicting_block_aborts_and_rolls_back(self):
+        db = self._db_with_history()
+        bad = self._interchange(
+            atts=[(8, 12, "cc" * 32)],  # fine on its own
+            blocks=[(100, "dd" * 32)],  # double proposal at slot 100
+        )
+        with pytest.raises(NotSafe):
+            db.import_interchange(bad, b"\x00" * 32)
+        # rollback: the fine attestation must NOT have been imported
+        db.check_and_insert_attestation(PK, 8, 12, b"\xcc" * 32)
+
+    def test_idempotent_reimport_ok(self):
+        db = self._db_with_history()
+        payload = db.export_interchange(b"\x00" * 32)
+        db.import_interchange(payload, b"\x00" * 32)  # no raise
+
+    def test_gvr_mismatch_rejected(self):
+        db = self._db_with_history()
+        payload = db.export_interchange(b"\x00" * 32)
+        with pytest.raises(NotSafe):
+            db.import_interchange(payload, b"\x11" * 32)

@@ -1,0 +1,32 @@
+"""Execution-layer interface: engine API types, the ExecutionLayer facade,
+and the in-process mock engine (reference beacon_node/execution_layer)."""
+
+from .engine_api import (
+    EngineApiError,
+    ExecutionEngine,
+    ForkchoiceState,
+    ForkchoiceUpdatedResponse,
+    PayloadAttributes,
+    PayloadStatusV1,
+    PayloadStatusV1Status,
+)
+from .execution_layer import (
+    ExecutionLayer,
+    PayloadInvalid,
+    PayloadVerificationStatus,
+)
+from .mock_engine import MockExecutionEngine
+
+__all__ = [
+    "EngineApiError",
+    "ExecutionEngine",
+    "ExecutionLayer",
+    "ForkchoiceState",
+    "ForkchoiceUpdatedResponse",
+    "MockExecutionEngine",
+    "PayloadAttributes",
+    "PayloadInvalid",
+    "PayloadStatusV1",
+    "PayloadStatusV1Status",
+    "PayloadVerificationStatus",
+]

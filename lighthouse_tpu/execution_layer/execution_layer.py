@@ -1,0 +1,107 @@
+"""ExecutionLayer facade (reference execution_layer/src/lib.rs): the
+consensus side's handle on an execution engine. Verb-level API used by the
+chain:
+
+  * notify_new_payload(payload) -> PayloadVerificationStatus -- wraps
+    engine_newPayload and interprets PayloadStatusV1 the way
+    payload_status.rs does (SYNCING/ACCEPTED => optimistic import).
+  * notify_forkchoice_updated(head/safe/finalized hash, attrs) -- drives
+    the EL's head and optionally starts payload building.
+  * get_payload(parent_hash, timestamp, prev_randao, fee_recipient) --
+    the production path: fcU with attributes then engine_getPayload.
+"""
+
+from __future__ import annotations
+
+import enum
+
+from .engine_api import (
+    EngineApiError,
+    ForkchoiceState,
+    PayloadAttributes,
+    PayloadStatusV1Status,
+)
+
+
+class PayloadVerificationStatus(str, enum.Enum):
+    """What block import learns about a payload (reference
+    fork_choice PayloadVerificationStatus / payload_status.rs)."""
+
+    VERIFIED = "verified"
+    OPTIMISTIC = "optimistic"
+    IRRELEVANT = "irrelevant"  # pre-merge blocks / default payloads
+
+
+class PayloadInvalid(ValueError):
+    def __init__(self, msg: str, latest_valid_hash: bytes | None = None):
+        super().__init__(msg)
+        self.latest_valid_hash = latest_valid_hash
+
+
+class ExecutionLayer:
+    def __init__(self, engine, suggested_fee_recipient: bytes = b"\x00" * 20):
+        self.engine = engine
+        self.suggested_fee_recipient = suggested_fee_recipient
+
+    # -- verification path (block import) -----------------------------------
+
+    def notify_new_payload(self, payload) -> PayloadVerificationStatus:
+        status = self.engine.new_payload(payload)
+        s = status.status
+        if s == PayloadStatusV1Status.VALID:
+            return PayloadVerificationStatus.VERIFIED
+        if s in (
+            PayloadStatusV1Status.SYNCING,
+            PayloadStatusV1Status.ACCEPTED,
+        ):
+            return PayloadVerificationStatus.OPTIMISTIC
+        raise PayloadInvalid(
+            f"execution payload invalid: {s.value}"
+            + (f" ({status.validation_error})" if status.validation_error else ""),
+            status.latest_valid_hash,
+        )
+
+    def notify_forkchoice_updated(
+        self,
+        head_block_hash: bytes,
+        finalized_block_hash: bytes = b"\x00" * 32,
+        safe_block_hash: bytes | None = None,
+        attributes: PayloadAttributes | None = None,
+    ):
+        state = ForkchoiceState(
+            head_block_hash=head_block_hash,
+            safe_block_hash=(
+                head_block_hash if safe_block_hash is None else safe_block_hash
+            ),
+            finalized_block_hash=finalized_block_hash,
+        )
+        resp = self.engine.forkchoice_updated(state, attributes)
+        if resp.payload_status.status == PayloadStatusV1Status.INVALID:
+            raise PayloadInvalid(
+                "forkchoiceUpdated: head payload invalid",
+                resp.payload_status.latest_valid_hash,
+            )
+        return resp
+
+    # -- production path -----------------------------------------------------
+
+    def get_payload(
+        self,
+        parent_hash: bytes,
+        timestamp: int,
+        prev_randao: bytes,
+        fee_recipient: bytes | None = None,
+    ):
+        attrs = PayloadAttributes(
+            timestamp=timestamp,
+            prev_randao=prev_randao,
+            suggested_fee_recipient=(
+                fee_recipient or self.suggested_fee_recipient
+            ),
+        )
+        resp = self.notify_forkchoice_updated(
+            parent_hash, attributes=attrs
+        )
+        if resp.payload_id is None:
+            raise EngineApiError("engine did not start payload build")
+        return self.engine.get_payload(resp.payload_id)

@@ -26,6 +26,11 @@ class ProtoNode:
     weight: int = 0
     best_child: int | None = None
     best_descendant: int | None = None
+    # optimistic-sync execution status (reference proto_array.rs
+    # ExecutionStatus): "irrelevant" (pre-merge), "optimistic" (engine said
+    # SYNCING/ACCEPTED), "valid", or "invalid"
+    execution_status: str = "irrelevant"
+    execution_block_hash: bytes = b""
 
 
 @dataclass
@@ -58,6 +63,8 @@ class ProtoArray:
         parent_root: bytes | None,
         justified_checkpoint: tuple[int, bytes],
         finalized_checkpoint: tuple[int, bytes],
+        execution_status: str = "irrelevant",
+        execution_block_hash: bytes = b"",
     ) -> None:
         if root in self.indices:
             return
@@ -68,6 +75,8 @@ class ProtoArray:
             parent=parent,
             justified_checkpoint=justified_checkpoint,
             finalized_checkpoint=finalized_checkpoint,
+            execution_status=execution_status,
+            execution_block_hash=bytes(execution_block_hash),
         )
         index = len(self.nodes)
         self.nodes.append(node)
@@ -188,6 +197,8 @@ class ProtoArray:
         """proto_array.rs node_is_viable_for_head: the node must agree with
         the store's justified and finalized checkpoints (epoch 0 wildcards
         accepted, matching genesis bootstrapping)."""
+        if node.execution_status == "invalid":
+            return False
         j_ok = (
             node.justified_checkpoint == self.justified_checkpoint
             or self.justified_checkpoint[0] == 0
@@ -197,6 +208,74 @@ class ProtoArray:
             or self.finalized_checkpoint[0] == 0
         )
         return j_ok and f_ok
+
+    # -- optimistic-sync invalidation (proto_array.rs ExecutionStatus
+    #    propagation; reference fork_choice.rs on_invalid_execution_payload) --
+
+    def on_valid_execution_payload(self, root: bytes) -> None:
+        """The engine confirmed a payload VALID: the block and all its
+        ancestors with payloads become valid (a valid payload implies valid
+        ancestry)."""
+        idx = self.indices.get(root)
+        while idx is not None:
+            node = self.nodes[idx]
+            if node.execution_status == "invalid":
+                raise ProtoArrayError(
+                    "engine said VALID for a block already known invalid"
+                )
+            if node.execution_status == "optimistic":
+                node.execution_status = "valid"
+            idx = node.parent
+
+    def on_invalid_execution_payload(
+        self, root: bytes, latest_valid_hash: bytes | None = None
+    ) -> None:
+        """Mark `root` and every descendant invalid; with a
+        latest_valid_hash, also invalidate ancestors whose payloads come
+        after it (they cannot be valid if a descendant's ancestry breaks
+        there). Rebuilds the best-child links afterwards."""
+        start = self.indices.get(root)
+        if start is None:
+            return
+        invalid = {start}
+        # ancestors back to latest_valid_hash
+        if latest_valid_hash is not None:
+            idx = self.nodes[start].parent
+            while idx is not None:
+                node = self.nodes[idx]
+                if node.execution_block_hash == bytes(latest_valid_hash):
+                    break
+                if node.execution_status in ("optimistic", "invalid"):
+                    invalid.add(idx)
+                    idx = node.parent
+                else:
+                    break
+        # descendants: nodes are insertion-ordered, parents precede children
+        for i, n in enumerate(self.nodes):
+            if n.parent in invalid:
+                invalid.add(i)
+        for i in invalid:
+            if self.nodes[i].execution_status == "valid":
+                # the engine vouched VALID for a block in the subtree it now
+                # calls invalid -- surface the inconsistency loudly (the
+                # valid path raises on the mirror-image conflict)
+                raise ProtoArrayError(
+                    "engine inconsistency: invalidating a subtree containing "
+                    f"a VALID block {self.nodes[i].root.hex()[:12]}"
+                )
+        for i in invalid:
+            self.nodes[i].execution_status = "invalid"
+        self._rebuild_best_links()
+
+    def _rebuild_best_links(self) -> None:
+        for n in self.nodes:
+            n.best_child = None
+            n.best_descendant = None
+        for i in range(len(self.nodes) - 1, -1, -1):
+            # bottom-up so child_leads chains are already settled
+            n = self.nodes[i]
+            if n.parent is not None:
+                self._maybe_update_best_child_and_descendant(n.parent, i)
 
     # -- pruning (proto_array.rs maybe_prune) --------------------------------
 
@@ -256,11 +335,39 @@ class ProtoArrayForkChoice:
         )
 
     def process_block(
-        self, slot, root, parent_root, justified_checkpoint, finalized_checkpoint
+        self,
+        slot,
+        root,
+        parent_root,
+        justified_checkpoint,
+        finalized_checkpoint,
+        execution_status: str = "irrelevant",
+        execution_block_hash: bytes = b"",
     ):
         self.proto_array.on_block(
-            slot, root, parent_root, justified_checkpoint, finalized_checkpoint
+            slot,
+            root,
+            parent_root,
+            justified_checkpoint,
+            finalized_checkpoint,
+            execution_status,
+            execution_block_hash,
         )
+
+    def on_valid_execution_payload(self, root: bytes) -> None:
+        self.proto_array.on_valid_execution_payload(root)
+
+    def on_invalid_execution_payload(
+        self, root: bytes, latest_valid_hash: bytes | None = None
+    ) -> None:
+        self.proto_array.on_invalid_execution_payload(root, latest_valid_hash)
+
+    def execution_status_of(self, root: bytes) -> str | None:
+        idx = self.proto_array.indices.get(root)
+        return self.proto_array.nodes[idx].execution_status if idx is not None else None
+
+    def is_optimistic(self, root: bytes) -> bool:
+        return self.execution_status_of(root) == "optimistic"
 
     def process_attestation(
         self, validator_index: int, block_root: bytes, target_epoch: int

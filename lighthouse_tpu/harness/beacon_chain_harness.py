@@ -22,14 +22,19 @@ class BeaconChainHarness:
         spec: ChainSpec | None = None,
         sign: bool = False,
         kv=None,
+        execution_layer=None,
     ):
-        self.producer = StateHarness(validator_count, preset, spec, sign=sign)
+        self.producer = StateHarness(
+            validator_count, preset, spec, sign=sign,
+            execution_layer=execution_layer,
+        )
         self.preset = preset
         self.spec = self.producer.spec
         self.store = HotColdDB(kv or MemoryStore(), preset, self.spec)
         self.chain = BeaconChain(
             self.store, self.producer.state, preset, self.spec
         )
+        self.chain.execution_layer = execution_layer
         self.strategy = (
             BlockSignatureStrategy.VERIFY_BULK
             if sign

@@ -54,6 +54,7 @@ class StateHarness:
         preset: Preset,
         spec: ChainSpec | None = None,
         sign: bool = True,
+        execution_layer=None,
     ):
         from ..types import interop_genesis_state
 
@@ -63,6 +64,8 @@ class StateHarness:
         self.state = interop_genesis_state(validator_count, preset, self.spec)
         self.genesis_block_root = self.state.latest_block_header.tree_hash_root()
         self.blocks: list = []
+        # optional EL handle: bellatrix blocks get real payloads from it
+        self.execution_layer = execution_layer
 
     # -- signing helpers -----------------------------------------------------
 
@@ -227,6 +230,29 @@ class StateHarness:
             # empty participation signs nothing: infinity signature (spec's
             # valid empty aggregate; SSZ default zero bytes do not parse)
             body.sync_aggregate.sync_committee_signature = INFINITY_SIGNATURE
+        if (
+            hasattr(body, "execution_payload")
+            and self.execution_layer is not None
+        ):
+            from ..state_transition.per_block import (
+                compute_timestamp_at_slot,
+                is_merge_transition_complete,
+            )
+            from ..types.helpers import get_randao_mix
+
+            if is_merge_transition_complete(state):
+                parent_hash = bytes(
+                    state.latest_execution_payload_header.block_hash
+                )
+            else:
+                # mock merge transition: build on the EL's genesis block
+                parent_hash = self.execution_layer.engine.genesis_hash
+            epoch = compute_epoch_at_slot(slot, self.preset)
+            body.execution_payload = self.execution_layer.get_payload(
+                parent_hash,
+                compute_timestamp_at_slot(state, slot, self.spec),
+                bytes(get_randao_mix(state, epoch, self.preset)),
+            )
 
         block = block_cls(
             slot=slot,

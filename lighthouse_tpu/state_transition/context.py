@@ -22,6 +22,11 @@ class ConsensusContext:
         self._committee_caches: dict[int, CommitteeCache] = {}
         self._pubkey_map: dict[bytes, int] | None = None
         self._pubkey_map_len = 0
+        # engine hook for process_execution_payload (payload -> bool or a
+        # PayloadVerificationStatus); None = no engine round trip (replay)
+        self.notify_new_payload = None
+        # set by the hook's caller after import, for optimistic tracking
+        self.payload_verification_status = None
 
     def pubkey_to_index(self, state, pubkey: bytes) -> int | None:
         """Registry pubkey -> validator index, built once and extended

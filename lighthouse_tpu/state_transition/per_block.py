@@ -118,6 +118,13 @@ def per_block_processing(
     process_block_header(
         state, block, preset, spec, ctxt.get_proposer_index(state)
     )
+    if getattr(block.body, "execution_payload", None) is not None:
+        # spec order: process_execution_payload runs right after the header
+        # (if_execution_enabled); randao is checked against the PRE-randao
+        # mix, hence before process_randao
+        process_execution_payload(
+            state, block.body, preset, spec, ctxt.notify_new_payload
+        )
     process_randao(state, block.body, preset, spec)
     process_eth1_data(state, block.body.eth1_data, preset)
     process_operations(state, block.body, preset, spec, ctxt)
@@ -581,3 +588,102 @@ def process_sync_aggregate(
         else:
             penalties[index] += participant_reward
     apply_balance_deltas(state, rewards, penalties)
+
+
+# --- execution payload (bellatrix) ------------------------------------------
+# Reference: consensus/state_processing per_block_processing's
+# process_execution_payload + is_merge_transition_* helpers; the engine
+# round trip mirrors execution_layer/src/lib.rs notify_new_payload.
+
+
+def is_merge_transition_complete(state) -> bool:
+    hdr = getattr(state, "latest_execution_payload_header", None)
+    if hdr is None:
+        return False
+    return any(bytes(hdr.block_hash))
+
+
+_DEFAULT_PAYLOAD_ROOTS: dict[type, bytes] = {}
+
+
+def _is_default_payload(payload) -> bool:
+    cls = type(payload)
+    root = _DEFAULT_PAYLOAD_ROOTS.get(cls)
+    if root is None:
+        root = _DEFAULT_PAYLOAD_ROOTS[cls] = cls().tree_hash_root()
+    return payload.tree_hash_root() == root
+
+
+def is_merge_transition_block(state, body) -> bool:
+    payload = getattr(body, "execution_payload", None)
+    if payload is None:
+        return False
+    return not is_merge_transition_complete(state) and not _is_default_payload(
+        payload
+    )
+
+
+def is_execution_enabled(state, body) -> bool:
+    return is_merge_transition_block(state, body) or (
+        is_merge_transition_complete(state)
+        and getattr(body, "execution_payload", None) is not None
+    )
+
+
+def compute_timestamp_at_slot(state, slot: int, spec) -> int:
+    return state.genesis_time + slot * spec.seconds_per_slot
+
+
+def payload_to_header(payload, preset: Preset):
+    """ExecutionPayload -> ExecutionPayloadHeader (transactions list
+    replaced by its hash tree root)."""
+    from ..types import types_for
+
+    t = types_for(preset)
+    kwargs = {
+        name: getattr(payload, name)
+        for name, _ in payload.ssz_fields
+        if name != "transactions"
+    }
+    tx_field = dict(payload.ssz_fields)["transactions"]
+    kwargs["transactions_root"] = tx_field.hash_tree_root(payload.transactions)
+    return t.ExecutionPayloadHeader(**kwargs)
+
+
+def process_execution_payload(
+    state, body, preset: Preset, spec, notify_new_payload=None
+):
+    """Spec process_execution_payload. `notify_new_payload` is the engine
+    hook (payload -> bool or PayloadVerificationStatus); None skips the
+    engine round trip (the NoVerification analogue used in replay)."""
+    from ..types import compute_epoch_at_slot as _epoch_at
+    from ..types.helpers import get_randao_mix
+
+    payload = body.execution_payload
+    if not is_execution_enabled(state, body):
+        # pre-merge: payload must be the default one (tree-root compare:
+        # SSZ offsets make even a default payload nonzero on the wire)
+        if not _is_default_payload(payload):
+            raise BlockProcessingError(
+                "execution payload present before the merge transition"
+            )
+        return
+    if is_merge_transition_complete(state):
+        if bytes(payload.parent_hash) != bytes(
+            state.latest_execution_payload_header.block_hash
+        ):
+            raise BlockProcessingError("payload parent hash mismatch")
+    epoch = _epoch_at(state.slot, preset)
+    if bytes(payload.prev_randao) != bytes(
+        get_randao_mix(state, epoch, preset)
+    ):
+        raise BlockProcessingError("payload prev_randao mismatch")
+    if int(payload.timestamp) != compute_timestamp_at_slot(
+        state, state.slot, spec
+    ):
+        raise BlockProcessingError("payload timestamp mismatch")
+    if notify_new_payload is not None:
+        ok = notify_new_payload(payload)
+        if ok is False:
+            raise BlockProcessingError("execution engine rejected payload")
+    state.latest_execution_payload_header = payload_to_header(payload, preset)

@@ -13,17 +13,22 @@ from .participation import (
 )
 
 
+_FORK_ORDER = ["phase0", "altair", "bellatrix"]
+
+
 def upgrade_state_if_due(state, preset: Preset, spec):
-    """Called after each slot increment; upgrades when the new slot's epoch
-    hits a fork epoch's first slot."""
+    """Called after each slot increment; upgrades (possibly through several
+    forks, e.g. a config with no separate altair epoch) when the new slot is
+    an epoch boundary and the spec names a later fork for that epoch."""
+    if state.slot % preset.slots_per_epoch != 0:
+        return state
     epoch = compute_epoch_at_slot(state.slot, preset)
-    if (
-        state.fork_name == "phase0"
-        and spec.altair_fork_epoch is not None
-        and epoch == spec.altair_fork_epoch
-        and state.slot % preset.slots_per_epoch == 0
-    ):
-        return upgrade_to_altair(state, preset, spec)
+    target = spec.fork_name_at_epoch(epoch)
+    while _FORK_ORDER.index(state.fork_name) < _FORK_ORDER.index(target):
+        if state.fork_name == "phase0":
+            state = upgrade_to_altair(state, preset, spec)
+        elif state.fork_name == "altair":
+            state = upgrade_to_bellatrix(state, preset, spec)
     return state
 
 
@@ -77,4 +82,23 @@ def upgrade_to_altair(pre, preset: Preset, spec):
     committee = compute_sync_committee(post, epoch + 1, preset, spec)
     post.current_sync_committee = committee
     post.next_sync_committee = committee
+    return post
+
+
+def upgrade_to_bellatrix(pre, preset: Preset, spec):
+    """altair -> bellatrix (reference upgrade.rs upgrade_to_bellatrix):
+    identical field copy plus a default (pre-merge, all-zero) execution
+    payload header; the merge itself happens when the first payload-bearing
+    block is imported (is_merge_transition_complete flips)."""
+    t = types_for(preset)
+    post = t.BeaconStateBellatrix.default()
+    for name, _ in pre.ssz_fields:
+        if hasattr(post, name):
+            setattr(post, name, getattr(pre, name))
+    post.fork = Fork(
+        previous_version=pre.fork.current_version,
+        current_version=spec.bellatrix_fork_version,
+        epoch=compute_epoch_at_slot(pre.slot, preset),
+    )
+    post.latest_execution_payload_header = t.ExecutionPayloadHeader()
     return post

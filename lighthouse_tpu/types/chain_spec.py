@@ -136,7 +136,11 @@ class ChainSpec:
         )
 
     @classmethod
-    def interop(cls, altair_fork_epoch: int | None = None) -> "ChainSpec":
+    def interop(
+        cls,
+        altair_fork_epoch: int | None = None,
+        bellatrix_fork_epoch: int | None = None,
+    ) -> "ChainSpec":
         """Deterministic local-testing spec (the reference's interop
         genesis path, lcli/environment interop support)."""
         return cls(
@@ -144,7 +148,8 @@ class ChainSpec:
             genesis_fork_version=b"\x00\x00\x00\x20",
             altair_fork_version=b"\x01\x00\x00\x20",
             altair_fork_epoch=altair_fork_epoch,
-            bellatrix_fork_epoch=None,
+            bellatrix_fork_version=b"\x02\x00\x00\x20",
+            bellatrix_fork_epoch=bellatrix_fork_epoch,
             seconds_per_slot=6,
             min_genesis_active_validator_count=64,
         )

@@ -17,7 +17,10 @@ from types import SimpleNamespace
 from ..ssz import (
     Bitlist,
     Bitvector,
+    ByteList,
+    ByteVector,
     Bytes4,
+    Bytes20,
     Bytes32,
     Bytes48,
     Bytes96,
@@ -27,6 +30,7 @@ from ..ssz import (
     container,
     uint8,
     uint64,
+    uint256,
 )
 from .presets import Preset
 
@@ -223,6 +227,46 @@ def types_for(preset: Preset) -> SimpleNamespace:
         block_roots: Vector(Bytes32, preset.slots_per_historical_root)
         state_roots: Vector(Bytes32, preset.slots_per_historical_root)
 
+    # -- bellatrix execution payloads (reference consensus/types/src/
+    #    execution_payload.rs + execution_payload_header.rs) ---------------
+
+    @container
+    class ExecutionPayload:
+        parent_hash: Bytes32
+        fee_recipient: Bytes20
+        state_root: Bytes32
+        receipts_root: Bytes32
+        logs_bloom: ByteVector(preset.bytes_per_logs_bloom)
+        prev_randao: Bytes32
+        block_number: uint64
+        gas_limit: uint64
+        gas_used: uint64
+        timestamp: uint64
+        extra_data: ByteList(preset.max_extra_data_bytes)
+        base_fee_per_gas: uint256
+        block_hash: Bytes32
+        transactions: List(
+            ByteList(preset.max_bytes_per_transaction),
+            preset.max_transactions_per_payload,
+        )
+
+    @container
+    class ExecutionPayloadHeader:
+        parent_hash: Bytes32
+        fee_recipient: Bytes20
+        state_root: Bytes32
+        receipts_root: Bytes32
+        logs_bloom: ByteVector(preset.bytes_per_logs_bloom)
+        prev_randao: Bytes32
+        block_number: uint64
+        gas_limit: uint64
+        gas_used: uint64
+        timestamp: uint64
+        extra_data: ByteList(preset.max_extra_data_bytes)
+        base_fee_per_gas: uint256
+        block_hash: Bytes32
+        transactions_root: Bytes32
+
     @container
     class BeaconBlockBody:
         randao_reveal: Bytes96
@@ -262,6 +306,27 @@ def types_for(preset: Preset) -> SimpleNamespace:
 
     BeaconBlockBodyAltair.fork_name = "altair"
 
+    @container
+    class BeaconBlockBodyBellatrix:
+        randao_reveal: Bytes96
+        eth1_data: Eth1Data.ssz_type
+        graffiti: Bytes32
+        proposer_slashings: List(
+            ProposerSlashing.ssz_type, preset.max_proposer_slashings
+        )
+        attester_slashings: List(
+            AttesterSlashing.ssz_type, preset.max_attester_slashings
+        )
+        attestations: List(Attestation.ssz_type, preset.max_attestations)
+        deposits: List(Deposit.ssz_type, preset.max_deposits)
+        voluntary_exits: List(
+            SignedVoluntaryExit.ssz_type, preset.max_voluntary_exits
+        )
+        sync_aggregate: SyncAggregate.ssz_type
+        execution_payload: ExecutionPayload.ssz_type
+
+    BeaconBlockBodyBellatrix.fork_name = "bellatrix"
+
     def _block_classes(body_cls, fork):
         @container
         class BeaconBlock:
@@ -283,6 +348,9 @@ def types_for(preset: Preset) -> SimpleNamespace:
     BeaconBlock, SignedBeaconBlock = _block_classes(BeaconBlockBody, "phase0")
     BeaconBlockAltair, SignedBeaconBlockAltair = _block_classes(
         BeaconBlockBodyAltair, "altair"
+    )
+    BeaconBlockBellatrix, SignedBeaconBlockBellatrix = _block_classes(
+        BeaconBlockBodyBellatrix, "bellatrix"
     )
 
     _state_common = dict(
@@ -331,23 +399,32 @@ def types_for(preset: Preset) -> SimpleNamespace:
         ),
     )
 
+    _altair_state_extra = dict(
+        previous_epoch_participation=List(
+            uint8, preset.validator_registry_limit
+        ),
+        current_epoch_participation=List(
+            uint8, preset.validator_registry_limit
+        ),
+        justification_bits=Bitvector(JUSTIFICATION_BITS_LENGTH),
+        previous_justified_checkpoint=Checkpoint.ssz_type,
+        current_justified_checkpoint=Checkpoint.ssz_type,
+        finalized_checkpoint=Checkpoint.ssz_type,
+        inactivity_scores=List(uint64, preset.validator_registry_limit),
+        current_sync_committee=SyncCommittee.ssz_type,
+        next_sync_committee=SyncCommittee.ssz_type,
+    )
+
     BeaconStateAltair = _make_state(
-        "BeaconStateAltair",
-        "altair",
+        "BeaconStateAltair", "altair", _altair_state_extra
+    )
+
+    BeaconStateBellatrix = _make_state(
+        "BeaconStateBellatrix",
+        "bellatrix",
         dict(
-            previous_epoch_participation=List(
-                uint8, preset.validator_registry_limit
-            ),
-            current_epoch_participation=List(
-                uint8, preset.validator_registry_limit
-            ),
-            justification_bits=Bitvector(JUSTIFICATION_BITS_LENGTH),
-            previous_justified_checkpoint=Checkpoint.ssz_type,
-            current_justified_checkpoint=Checkpoint.ssz_type,
-            finalized_checkpoint=Checkpoint.ssz_type,
-            inactivity_scores=List(uint64, preset.validator_registry_limit),
-            current_sync_committee=SyncCommittee.ssz_type,
-            next_sync_committee=SyncCommittee.ssz_type,
+            **_altair_state_extra,
+            latest_execution_payload_header=ExecutionPayloadHeader.ssz_type,
         ),
     )
 
@@ -371,8 +448,14 @@ def types_for(preset: Preset) -> SimpleNamespace:
         SignedBeaconBlock=SignedBeaconBlock,
         BeaconBlockAltair=BeaconBlockAltair,
         SignedBeaconBlockAltair=SignedBeaconBlockAltair,
+        ExecutionPayload=ExecutionPayload,
+        ExecutionPayloadHeader=ExecutionPayloadHeader,
+        BeaconBlockBodyBellatrix=BeaconBlockBodyBellatrix,
+        BeaconBlockBellatrix=BeaconBlockBellatrix,
+        SignedBeaconBlockBellatrix=SignedBeaconBlockBellatrix,
         BeaconState=BeaconState,
         BeaconStateAltair=BeaconStateAltair,
+        BeaconStateBellatrix=BeaconStateBellatrix,
     )
 
 
@@ -382,6 +465,12 @@ def block_classes_for(t: SimpleNamespace, fork: str):
         return t.BeaconBlock, t.SignedBeaconBlock, t.BeaconBlockBody
     if fork == "altair":
         return t.BeaconBlockAltair, t.SignedBeaconBlockAltair, t.BeaconBlockBodyAltair
+    if fork == "bellatrix":
+        return (
+            t.BeaconBlockBellatrix,
+            t.SignedBeaconBlockBellatrix,
+            t.BeaconBlockBodyBellatrix,
+        )
     raise ValueError(f"unsupported fork {fork!r}")
 
 
@@ -390,4 +479,6 @@ def state_class_for(t: SimpleNamespace, fork: str):
         return t.BeaconState
     if fork == "altair":
         return t.BeaconStateAltair
+    if fork == "bellatrix":
+        return t.BeaconStateBellatrix
     raise ValueError(f"unsupported fork {fork!r}")

@@ -35,6 +35,11 @@ class Preset:
     sync_committee_subnet_count: int = 4
     # deposit contract tree
     deposit_contract_tree_depth: int = 32
+    # bellatrix (execution payload sizing; same on mainnet and minimal)
+    bytes_per_logs_bloom: int = 256
+    max_bytes_per_transaction: int = 2**30
+    max_transactions_per_payload: int = 2**20
+    max_extra_data_bytes: int = 32
 
     @property
     def slots_per_eth1_voting_period(self) -> int:

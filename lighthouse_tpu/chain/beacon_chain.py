@@ -199,6 +199,10 @@ class BeaconChain:
             execution_status=execution_status,
             execution_block_hash=execution_block_hash,
         )
+        if execution_status == "valid":
+            # engine-API semantics: a VALID payload implies its ancestors'
+            # payloads are valid too -- clear any stale optimistic marks
+            self.fork_choice.on_valid_execution_payload(block_root)
         # fork-choice also counts the block's attestations
         for att in block.body.attestations:
             indexed = ctxt.get_indexed_attestation(state, att)

@@ -25,6 +25,14 @@ from ..pool import (
 from ..processor import BeaconProcessor
 from ..types import compute_epoch_at_slot, compute_fork_digest
 from .message_bus import MessageBus, topic_name
+from ..chain.sync_committee_verification import (
+    ObservedSyncAggregators,
+    ObservedSyncContributors,
+    SyncContributionPool,
+    SyncMessagePool,
+    batch_verify_contributions,
+    batch_verify_sync_messages,
+)
 
 GOSSIP_PENALTY = -10
 BAN_THRESHOLD = -50
@@ -45,12 +53,19 @@ class NetworkNode:
         self.observed_aggregates = ObservedAggregates()
         self.observed_aggregators = ObservedAggregators()
         self.observed_block_producers = ObservedBlockProducers()
+        self.observed_sync_contributors = ObservedSyncContributors()
+        self.observed_sync_aggregators = ObservedSyncAggregators()
+        self.observed_contributions = ObservedAggregates()
+        self.sync_message_pool = SyncMessagePool(chain.preset)
+        self.sync_contribution_pool = SyncContributionPool(chain.preset)
         self.peer_scores: dict[str, int] = {}
         self.processor = BeaconProcessor(
             handlers={
                 "gossip_block": self._work_block,
                 "gossip_aggregate": self._work_aggregates,
                 "gossip_attestation": self._work_attestations,
+                "gossip_sync_message": self._work_sync_messages,
+                "gossip_sync_contribution": self._work_sync_contributions,
             }
         )
 
@@ -70,6 +85,18 @@ class NetworkNode:
                 peer_id,
                 topic_name("beacon_attestation", self.fork_digest, subnet),
                 self._on_gossip_attestation,
+            )
+        self._topic_contribution = topic_name(
+            "sync_committee_contribution_and_proof", self.fork_digest
+        )
+        bus.subscribe(
+            peer_id, self._topic_contribution, self._on_gossip_contribution
+        )
+        for subnet in range(chain.preset.sync_committee_subnet_count):
+            bus.subscribe(
+                peer_id,
+                topic_name("sync_committee", self.fork_digest, subnet),
+                self._make_sync_subnet_handler(subnet),
             )
         bus.register_rpc(peer_id, STATUS_PROTOCOL, self._rpc_status)
         bus.register_rpc(peer_id, BLOCKS_BY_RANGE, self._rpc_blocks_by_range)
@@ -103,6 +130,21 @@ class NetworkNode:
     def _on_gossip_attestation(self, attestation, source: str) -> None:
         if not self.is_banned(source):
             self.processor.submit("gossip_attestation", (attestation, source))
+
+    def _make_sync_subnet_handler(self, subnet: int):
+        def handler(message, source: str) -> None:
+            if not self.is_banned(source):
+                self.processor.submit(
+                    "gossip_sync_message", (message, subnet, source)
+                )
+
+        return handler
+
+    def _on_gossip_contribution(self, signed_contribution, source: str) -> None:
+        if not self.is_banned(source):
+            self.processor.submit(
+                "gossip_sync_contribution", (signed_contribution, source)
+            )
 
     # -- workers (worker/gossip_methods.rs) ---------------------------------
 
@@ -147,6 +189,33 @@ class NetworkNode:
             if "signature" in reason:
                 self.penalize(sources.get(id(att), ""))
 
+    def _work_sync_messages(self, items) -> None:
+        msgs = [(m, subnet) for m, subnet, _ in items]
+        sources = {id(m): s for m, _, s in items}
+        verified, rejected = batch_verify_sync_messages(
+            self.chain, msgs, self.observed_sync_contributors
+        )
+        for v in verified:
+            self.sync_message_pool.insert(v)
+        for msg, reason in rejected:
+            if "signature" in reason:
+                self.penalize(sources.get(id(msg), ""))
+
+    def _work_sync_contributions(self, items) -> None:
+        contributions = [c for c, _ in items]
+        sources = {id(c): s for c, s in items}
+        verified, rejected = batch_verify_contributions(
+            self.chain,
+            contributions,
+            self.observed_sync_aggregators,
+            self.observed_contributions,
+        )
+        for v in verified:
+            self.sync_contribution_pool.insert(v)
+        for c, reason in rejected:
+            if "signature" in reason or "selection" in reason:
+                self.penalize(sources.get(id(c), ""))
+
     # -- publish (the local node's own messages) ----------------------------
 
     def publish_block(self, signed_block) -> None:
@@ -160,6 +229,24 @@ class NetworkNode:
             self.peer_id,
             topic_name("beacon_attestation", self.fork_digest, subnet),
             attestation,
+        )
+
+    def publish_sync_message(self, message, subnet: int = 0) -> None:
+        self.processor.submit(
+            "gossip_sync_message", (message, subnet, self.peer_id)
+        )
+        self.bus.publish(
+            self.peer_id,
+            topic_name("sync_committee", self.fork_digest, subnet),
+            message,
+        )
+
+    def publish_sync_contribution(self, signed_contribution) -> None:
+        self.processor.submit(
+            "gossip_sync_contribution", (signed_contribution, self.peer_id)
+        )
+        self.bus.publish(
+            self.peer_id, self._topic_contribution, signed_contribution
         )
 
     def publish_aggregate(self, signed_aggregate) -> None:

@@ -71,6 +71,8 @@ class BeaconProcessor:
         "gossip_block",
         "gossip_aggregate",
         "gossip_attestation",
+        "gossip_sync_contribution",
+        "gossip_sync_message",
         "sync_contribution",
         "gossip_exit",
         "gossip_proposer_slashing",
@@ -90,6 +92,12 @@ class BeaconProcessor:
                 "gossip_attestation", 16384, lifo=True
             ),
             "sync_contribution": WorkQueue("sync_contribution", 4096),
+            "gossip_sync_message": WorkQueue(
+                "gossip_sync_message", 16384, lifo=True
+            ),
+            "gossip_sync_contribution": WorkQueue(
+                "gossip_sync_contribution", 4096
+            ),
             "gossip_exit": WorkQueue("gossip_exit", 4096),
             "gossip_proposer_slashing": WorkQueue(
                 "gossip_proposer_slashing", 4096
@@ -99,7 +107,12 @@ class BeaconProcessor:
             ),
             "api_request": WorkQueue("api_request", 1024),
         }
-        self.batched = {"gossip_aggregate", "gossip_attestation"}
+        self.batched = {
+            "gossip_aggregate",
+            "gossip_attestation",
+            "gossip_sync_message",
+            "gossip_sync_contribution",
+        }
         self.handlers = handlers
         self._lock = threading.Lock()
         self._stop = threading.Event()

@@ -261,7 +261,7 @@ def sync_aggregate_signature_set(
 def sync_selection_proof_signature_set(
     state, get_pubkey, signed_contribution, preset, spec
 ) -> SignatureSet:
-    from ..ssz import container, uint64 as u64
+    from ..types.containers import SyncAggregatorSelectionData
 
     msg = signed_contribution.message
     contribution = msg.contribution
@@ -269,12 +269,6 @@ def sync_selection_proof_signature_set(
     domain = get_domain(
         state, DOMAIN_SYNC_COMMITTEE_SELECTION_PROOF, epoch, preset
     )
-
-    @container
-    class SyncAggregatorSelectionData:
-        slot: u64
-        subcommittee_index: u64
-
     data = SyncAggregatorSelectionData(
         slot=contribution.slot,
         subcommittee_index=contribution.subcommittee_index,

@@ -74,6 +74,7 @@ class ChainSpec:
 
     # attestation aggregation
     target_aggregators_per_committee: int = 16
+    target_aggregators_per_sync_subcommittee: int = 16
     attestation_subnet_count: int = 64
 
     # deposit contract

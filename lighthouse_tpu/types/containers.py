@@ -146,6 +146,12 @@ class ProposerSlashing:
 
 
 @container
+class SyncAggregatorSelectionData:
+    slot: uint64
+    subcommittee_index: uint64
+
+
+@container
 class SyncCommitteeMessage:
     slot: uint64
     beacon_block_root: Bytes32

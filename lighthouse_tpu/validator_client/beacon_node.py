@@ -31,12 +31,31 @@ class InProcessBeaconNode:
         chain: BeaconChain,
         op_pool: OperationPool | None = None,
         naive_pool: NaiveAggregationPool | None = None,
+        sync_message_pool=None,
+        sync_contribution_pool=None,
     ):
+        from ..chain.sync_committee_verification import (
+            ObservedSyncAggregators,
+            ObservedSyncContributors,
+            SyncContributionPool,
+            SyncMessagePool,
+        )
+        from ..pool.observed import ObservedAggregates
+
         self.chain = chain
         self.preset: Preset = chain.preset
         self.spec = chain.spec
         self.op_pool = op_pool or OperationPool(chain.preset, chain.spec)
         self.naive_pool = naive_pool or NaiveAggregationPool()
+        self.sync_message_pool = sync_message_pool or SyncMessagePool(
+            chain.preset
+        )
+        self.sync_contribution_pool = (
+            sync_contribution_pool or SyncContributionPool(chain.preset)
+        )
+        self.observed_sync_contributors = ObservedSyncContributors()
+        self.observed_sync_aggregators = ObservedSyncAggregators()
+        self.observed_contributions = ObservedAggregates()
         self.healthy = True  # toggled by tests to exercise VC failover
 
     # -- status --------------------------------------------------------------
@@ -134,9 +153,13 @@ class InProcessBeaconNode:
         body.attester_slashings = tuple(att)
         body.voluntary_exits = tuple(exits)
         if hasattr(body, "sync_aggregate"):
-            from ..crypto.bls import INFINITY_SIGNATURE
-
-            body.sync_aggregate.sync_committee_signature = INFINITY_SIGNATURE
+            # the gossip-fed contribution pool supplies the aggregate for
+            # the PREVIOUS slot's head (sync_committee_verification feeds
+            # it); empty pool -> the valid empty aggregate
+            prev_root = state.latest_block_header.tree_hash_root()
+            body.sync_aggregate = self.sync_contribution_pool.get_sync_aggregate(
+                t, slot - 1, prev_root
+            )
 
         block = block_cls(
             slot=slot,
@@ -204,3 +227,63 @@ class InProcessBeaconNode:
 
     def publish_aggregate_and_proof(self, signed_aggregate) -> None:
         self.op_pool.insert_attestation(signed_aggregate.message.aggregate)
+
+    # -- sync-committee endpoints (validator/sync_committee_* routes) --------
+
+    def get_sync_duties(self, epoch: int, indices) -> list[dict]:
+        """Which of `indices` sit in the current sync committee, and on
+        which subnets (duties_service/sync.rs poll)."""
+        from ..chain.sync_committee_verification import (
+            subnets_for_sync_validator,
+        )
+
+        state = self.chain.head_state
+        if not hasattr(state, "current_sync_committee"):
+            return []
+        out = []
+        for idx in indices:
+            subnets = subnets_for_sync_validator(state, self.preset, idx)
+            if subnets:
+                out.append({"validator_index": idx, "subnets": subnets})
+        return out
+
+    def publish_sync_message(self, message, subnet: int = 0) -> None:
+        """Verify + pool a gossip sync-committee message (the in-process
+        stand-in for the sync_committee_{subnet} topic)."""
+        from ..chain.sync_committee_verification import (
+            batch_verify_sync_messages,
+        )
+
+        verified, rejected = batch_verify_sync_messages(
+            self.chain, [(message, subnet)], self.observed_sync_contributors
+        )
+        for v in verified:
+            self.sync_message_pool.insert(v)
+        for _, reason in rejected:
+            if "already" in reason:
+                return  # duplicate suppression is not an error
+            raise ValueError(f"sync message rejected: {reason}")
+
+    def get_sync_contribution(self, slot: int, block_root: bytes, subnet: int):
+        t = types_for(self.preset)
+        return self.sync_message_pool.get_contribution(
+            t, slot, block_root, subnet
+        )
+
+    def publish_contribution_and_proof(self, signed_contribution) -> None:
+        from ..chain.sync_committee_verification import (
+            batch_verify_contributions,
+        )
+
+        verified, rejected = batch_verify_contributions(
+            self.chain,
+            [signed_contribution],
+            self.observed_sync_aggregators,
+            self.observed_contributions,
+        )
+        for v in verified:
+            self.sync_contribution_pool.insert(v)
+        for _, reason in rejected:
+            if "already" in reason:
+                return  # duplicate suppression is not an error
+            raise ValueError(f"contribution rejected: {reason}")

@@ -123,6 +123,8 @@ class ValidatorClient:
         self.blocks_proposed: list[bytes] = []
         self.attestations_published = 0
         self.aggregates_published = 0
+        self.sync_messages_published = 0
+        self.sync_contributions_published = 0
         self.doppelganger_detected: list[bytes] = []
         self._dg_start: dict[bytes, int] = {}
 
@@ -140,7 +142,9 @@ class ValidatorClient:
         self._doppelganger_scan(epoch)
         self._block_duty(slot)
         self._attestation_duty(slot)
+        self._sync_committee_duty(slot)
         self._aggregation_duty(slot)
+        self._sync_aggregation_duty(slot)
 
     def _block_duty(self, slot: int) -> None:
         proposer = self.duties.block_proposal_duty(slot, self.preset)
@@ -232,6 +236,100 @@ class ValidatorClient:
                 )
             )
             self.aggregates_published += 1
+
+    # -- sync committee (sync_committee_service.rs) --------------------------
+
+    def _sync_duties(self, slot: int):
+        node = self.nodes.best()
+        epoch = compute_epoch_at_slot(slot, self.preset)
+        indices = sorted(self.duties.our_indices())
+        if not indices or not hasattr(node, "get_sync_duties"):
+            return node, []
+        return node, node.get_sync_duties(epoch, indices)
+
+    def _sync_committee_duty(self, slot: int) -> None:
+        """At slot start + 1/3 (the attestation tick): sign the head root
+        as a SyncCommitteeMessage on each of our subnets."""
+        node, duties = self._sync_duties(slot)
+        if not duties:
+            return
+        t = types_for(self.preset)
+        state = node.signing_context()
+        head_root = node.chain.head_root if hasattr(node, "chain") else None
+        if head_root is None:
+            return
+        for d in duties:
+            pubkey = self._pubkey_for_index(d["validator_index"])
+            if pubkey is None:
+                continue
+            try:
+                sig = self.store.sign_sync_committee_message(
+                    pubkey, slot, head_root, state
+                )
+            except (NotSafe, DoppelgangerHold):
+                continue
+            from ..types.containers import SyncCommitteeMessage
+
+            msg = SyncCommitteeMessage(
+                slot=slot,
+                beacon_block_root=head_root,
+                validator_index=d["validator_index"],
+                signature=sig.to_bytes(),
+            )
+            for subnet in d["subnets"]:
+                node.publish_sync_message(msg, subnet)
+                self.sync_messages_published += 1
+
+    def _sync_aggregation_duty(self, slot: int) -> None:
+        """At 2/3 slot: aggregators fetch their subnet's contribution and
+        publish SignedContributionAndProof."""
+        from ..chain.sync_committee_verification import (
+            is_sync_committee_aggregator,
+        )
+
+        node, duties = self._sync_duties(slot)
+        if not duties:
+            return
+        t = types_for(self.preset)
+        state = node.signing_context()
+        head_root = node.chain.head_root if hasattr(node, "chain") else None
+        for d in duties:
+            pubkey = self._pubkey_for_index(d["validator_index"])
+            if pubkey is None:
+                continue
+            for subnet in d["subnets"]:
+                try:
+                    proof = self.store.sign_sync_selection_proof(
+                        pubkey, slot, subnet, state
+                    )
+                except DoppelgangerHold:
+                    continue
+                if not is_sync_committee_aggregator(
+                    proof.to_bytes(), self.preset, self.spec
+                ):
+                    continue
+                contribution = node.get_sync_contribution(
+                    slot, head_root, subnet
+                )
+                if contribution is None:
+                    continue
+                msg = t.ContributionAndProof(
+                    aggregator_index=d["validator_index"],
+                    contribution=contribution,
+                    selection_proof=proof.to_bytes(),
+                )
+                try:
+                    sig = self.store.sign_contribution_and_proof(
+                        pubkey, msg, state
+                    )
+                except DoppelgangerHold:
+                    continue
+                node.publish_contribution_and_proof(
+                    t.SignedContributionAndProof(
+                        message=msg, signature=sig.to_bytes()
+                    )
+                )
+                self.sync_contributions_published += 1
 
     # -- doppelganger (doppelganger_service.rs:1-25) ------------------------
 

@@ -135,3 +135,43 @@ class ValidatorStore:
         )
         root = compute_signing_root(msg, domain)
         return self._method(pubkey).sign(root)
+
+    # -- sync committee (validator_store.rs sync-committee signing) ----------
+
+    def sign_sync_committee_message(
+        self, pubkey: bytes, slot: int, beacon_block_root: bytes, state
+    ) -> Signature:
+        from ..types.chain_spec import DOMAIN_SYNC_COMMITTEE
+
+        epoch = compute_epoch_at_slot(slot, self.preset)
+        domain = get_domain(state, DOMAIN_SYNC_COMMITTEE, epoch, self.preset)
+        root = SigningData(
+            object_root=bytes(beacon_block_root), domain=domain
+        ).tree_hash_root()
+        return self._method(pubkey).sign(root)
+
+    def sign_sync_selection_proof(
+        self, pubkey: bytes, slot: int, subcommittee_index: int, state
+    ) -> Signature:
+        from ..types.chain_spec import DOMAIN_SYNC_COMMITTEE_SELECTION_PROOF
+        from ..types.containers import SyncAggregatorSelectionData
+
+        epoch = compute_epoch_at_slot(slot, self.preset)
+        domain = get_domain(
+            state, DOMAIN_SYNC_COMMITTEE_SELECTION_PROOF, epoch, self.preset
+        )
+        data = SyncAggregatorSelectionData(
+            slot=slot, subcommittee_index=subcommittee_index
+        )
+        root = compute_signing_root(data, domain)
+        return self._method(pubkey).sign(root)
+
+    def sign_contribution_and_proof(self, pubkey: bytes, msg, state) -> Signature:
+        from ..types.chain_spec import DOMAIN_CONTRIBUTION_AND_PROOF
+
+        epoch = compute_epoch_at_slot(msg.contribution.slot, self.preset)
+        domain = get_domain(
+            state, DOMAIN_CONTRIBUTION_AND_PROOF, epoch, self.preset
+        )
+        root = compute_signing_root(msg, domain)
+        return self._method(pubkey).sign(root)

@@ -13,6 +13,7 @@ from __future__ import annotations
 
 from ..crypto.bls import verify_signature_sets
 from ..fork_choice import ForkChoice
+from ..ssz import cached_root
 from ..state_transition import (
     BlockProcessingError,
     BlockSignatureStrategy,
@@ -184,11 +185,15 @@ class BeaconChain:
                 is PayloadVerificationStatus.VERIFIED
                 else "optimistic"
             )
-        state_root = state.tree_hash_root()
+        state_root = cached_root(state)
         if bytes(block.state_root) != state_root:
             raise BlockError("block state_root mismatch")
 
         self.store.put_block(block_root, signed_block)
+        # drop the incremental-hash cache before retaining: stored states
+        # are never re-rooted in place (later work clones them), so keeping
+        # the merkle layers would ~double per-state memory for nothing
+        state.__dict__.pop("_lh_tree_cache", None)
         self.store.put_state(state_root, state)
         self._states[block_root] = state
 

@@ -53,17 +53,31 @@ def sync_subcommittee_pubkeys(state, preset, subcommittee_index: int):
     return list(state.current_sync_committee.pubkeys[start : start + size])
 
 
-def subnets_for_sync_validator(state, preset, validator_index: int):
-    """subnet id -> positions-in-subcommittee for a validator (spec
-    compute_subnets_for_sync_committee)."""
+def sync_committee_positions(state, preset) -> dict[bytes, list[int]]:
+    """pubkey -> committee positions, one pass over the committee (the
+    per-validator lookup table duties_service/sync.rs builds per period)."""
     if not hasattr(state, "current_sync_committee"):
         raise SyncCommitteeError("head state predates altair")
+    out: dict[bytes, list[int]] = {}
+    for i, committee_pk in enumerate(state.current_sync_committee.pubkeys):
+        out.setdefault(bytes(committee_pk), []).append(i)
+    return out
+
+
+def subnets_for_sync_validator(
+    state, preset, validator_index: int, positions=None
+):
+    """subnet id -> positions-in-subcommittee for a validator (spec
+    compute_subnets_for_sync_committee). Pass a `sync_committee_positions`
+    table when resolving many validators to avoid rescanning the committee
+    per index."""
+    if positions is None:
+        positions = sync_committee_positions(state, preset)
     pk = bytes(state.validators[validator_index].pubkey)
     size = preset.sync_subcommittee_size
     out: dict[int, list[int]] = {}
-    for i, committee_pk in enumerate(state.current_sync_committee.pubkeys):
-        if bytes(committee_pk) == pk:
-            out.setdefault(i // size, []).append(i % size)
+    for i in positions.get(pk, ()):
+        out.setdefault(i // size, []).append(i % size)
     return out
 
 

@@ -19,8 +19,16 @@ class DepositDataTree:
     def push(self, deposit_data) -> None:
         self.leaves.append(deposit_data.tree_hash_root())
 
+    def truncate(self, count: int) -> None:
+        """Drop leaves past `count` (eth1 reorg rewind, service.rs)."""
+        del self.leaves[count:]
+
     def _branch_root(self, count: int | None = None) -> bytes:
         """Root over the first `count` leaves (default all)."""
+        if count is not None and count > len(self.leaves):
+            raise ValueError(
+                f"deposit tree has {len(self.leaves)} leaves, need {count}"
+            )
         leaves = self.leaves[: count if count is not None else len(self.leaves)]
         layer = list(leaves)
         for d in range(DEPOSIT_TREE_DEPTH):

@@ -1,11 +1,23 @@
 """Eth1 data service (reference beacon_node/eth1/src/service.rs:
 deposit-log polling into a DepositCache + BlockCache for eth1-data
-voting). The provider boundary is a duck type; MockEth1Provider plays the
-role of the reference's eth1 test rig (testing/eth1_test_rig)."""
+voting, with reorg rewind).
+
+Provider interface (duck type — both the in-process `MockEth1Provider`
+and the JSON-RPC `JsonRpcEth1Provider` in jsonrpc.py implement it):
+
+    head_number() -> int           # latest block number, -1 if empty
+    get_block(number) -> Eth1Block | None
+    get_deposit_logs(from_index) -> list[DepositData]   # log order
+
+`update()` is the reference's update loop (service.rs:1-1286): it first
+re-validates the cached tip against the remote chain and rewinds the
+block cache and deposit tree across reorgs, then appends parent-linked
+new blocks and ingests new deposit logs."""
 
 from __future__ import annotations
 
-from dataclasses import dataclass, field
+import hashlib
+from dataclasses import dataclass
 
 from ..types.containers import Eth1Data
 from .deposit_tree import DepositDataTree
@@ -17,32 +29,58 @@ class Eth1Block:
     hash: bytes
     timestamp: int
     deposit_count: int
+    parent_hash: bytes = bytes(32)
 
 
 class MockEth1Provider:
-    """In-process eth1 chain: injectable blocks + deposit logs."""
+    """In-process eth1 chain: injectable blocks + deposit logs + reorgs."""
 
     def __init__(self):
         self.blocks: list[Eth1Block] = []
-        self.deposit_logs: list = []  # DepositData in log order
+        self.deposit_logs: list = []  # (DepositData, block_number)
+        self._fork_salt = 0
+
+    def _hash(self, number: int) -> bytes:
+        return hashlib.sha256(
+            b"eth1"
+            + number.to_bytes(8, "little")
+            + self._fork_salt.to_bytes(8, "little")
+        ).digest()
 
     def add_block(self, timestamp: int, new_deposits=()) -> Eth1Block:
+        number = len(self.blocks)
         for d in new_deposits:
-            self.deposit_logs.append(d)
+            self.deposit_logs.append((d, number))
         blk = Eth1Block(
-            number=len(self.blocks),
-            hash=bytes([len(self.blocks) % 256]) * 32,
+            number=number,
+            hash=self._hash(number),
+            parent_hash=self.blocks[-1].hash if self.blocks else bytes(32),
             timestamp=timestamp,
             deposit_count=len(self.deposit_logs),
         )
         self.blocks.append(blk)
         return blk
 
-    def get_blocks(self, from_number: int) -> list[Eth1Block]:
-        return self.blocks[from_number:]
+    def reorg(self, depth: int) -> None:
+        """Drop the top `depth` blocks and their deposit logs; replacement
+        blocks hash differently (fork salt)."""
+        keep = len(self.blocks) - depth
+        self.blocks = self.blocks[:keep]
+        self.deposit_logs = [l for l in self.deposit_logs if l[1] < keep]
+        self._fork_salt += 1
+
+    # -- provider interface --------------------------------------------------
+
+    def head_number(self) -> int:
+        return len(self.blocks) - 1
+
+    def get_block(self, number: int) -> Eth1Block | None:
+        if 0 <= number < len(self.blocks):
+            return self.blocks[number]
+        return None
 
     def get_deposit_logs(self, from_index: int) -> list:
-        return self.deposit_logs[from_index:]
+        return [d for d, _ in self.deposit_logs[from_index:]]
 
 
 class Eth1Service:
@@ -51,16 +89,57 @@ class Eth1Service:
         self.follow_distance = follow_distance
         self.deposit_tree = DepositDataTree()
         self.block_cache: list[Eth1Block] = []
+        self._deposit_data: list = []  # log order, parallel to tree leaves
 
     # -- polling (service.rs update loop) -----------------------------------
 
     def update(self) -> None:
-        for log in self.provider.get_deposit_logs(
-            len(self.deposit_tree.leaves)
-        ):
+        # 1. reorg rewind: pop cached tips the remote chain no longer has
+        rewound = False
+        while self.block_cache:
+            tip = self.block_cache[-1]
+            remote = self.provider.get_block(tip.number)
+            if remote is not None and remote.hash == tip.hash:
+                break
+            self.block_cache.pop()
+            rewound = True
+        anchor_deposits = (
+            self.block_cache[-1].deposit_count if self.block_cache else 0
+        )
+        truncated = len(self._deposit_data) > anchor_deposits
+        if truncated:
+            self.deposit_tree.truncate(anchor_deposits)
+            del self._deposit_data[anchor_deposits:]
+        if (rewound or truncated) and hasattr(self.provider, "reset_log_scan"):
+            # a reorg can replace same-numbered blocks whose logs an
+            # incremental scanner would skip; force a full rescan. The
+            # truncated-without-rewind case matters too: logs may have been
+            # scanned past the cached tip before the reorg (the provider
+            # watermark leads the block cache), so a tip match alone does
+            # not prove the scanned logs are canonical.
+            self.provider.reset_log_scan()
+
+        # 2. ingest deposit logs BEFORE extending the block cache: a
+        # transport failure between the two steps must never leave cached
+        # blocks whose deposit_count exceeds the tree (the eth1 vote's
+        # deposit_root would silently not cover its deposit_count)
+        for log in self.provider.get_deposit_logs(len(self._deposit_data)):
             self.deposit_tree.push(log)
-        known = len(self.block_cache)
-        self.block_cache.extend(self.provider.get_blocks(known))
+            self._deposit_data.append(log)
+
+        # 3. append parent-linked new blocks up to the remote head, never
+        # past what the deposit tree can prove
+        head = self.provider.head_number()
+        start = self.block_cache[-1].number + 1 if self.block_cache else 0
+        for number in range(start, head + 1):
+            blk = self.provider.get_block(number)
+            if blk is None:
+                break
+            if self.block_cache and blk.parent_hash != self.block_cache[-1].hash:
+                break  # raced another reorg; next update rewinds
+            if blk.deposit_count > len(self._deposit_data):
+                break  # logs for this block not ingested yet; next update
+            self.block_cache.append(blk)
 
     # -- eth1 data voting (eth1_data aggregation) ---------------------------
 
@@ -81,11 +160,7 @@ class Eth1Service:
         proved against the state's eth1_data root."""
         start = state.eth1_deposit_index
         count = state.eth1_data.deposit_count
-        out = []
-        for i in range(start, min(count, start + max_deposits)):
-            out.append(self.deposit_tree.deposit(i, _data_at(self, i), count))
-        return out
-
-
-def _data_at(service: Eth1Service, index: int):
-    return service.provider.deposit_logs[index]
+        return [
+            self.deposit_tree.deposit(i, self._deposit_data[i], count)
+            for i in range(start, min(count, start + max_deposits))
+        ]

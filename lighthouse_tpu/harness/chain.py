@@ -11,7 +11,7 @@ fake_crypto feature + harness pairing)."""
 from __future__ import annotations
 
 from ..crypto.bls import AggregateSignature, INFINITY_SIGNATURE, Signature
-from ..ssz import uint64
+from ..ssz import cached_root, uint64
 from ..types import (
     ChainSpec,
     compute_epoch_at_slot,
@@ -273,7 +273,7 @@ class StateHarness:
             strategy=BlockSignatureStrategy.NO_VERIFICATION,
             verified_proposer_index=proposer,
         )
-        block.state_root = scratch.tree_hash_root()
+        block.state_root = cached_root(scratch)
 
         epoch = compute_epoch_at_slot(slot, self.preset)
         domain = get_domain(state, DOMAIN_BEACON_PROPOSER, epoch, self.preset)
@@ -297,7 +297,7 @@ class StateHarness:
         per_block_processing(
             state, signed_block, self.preset, self.spec, strategy=strategy
         )
-        if bytes(signed_block.message.state_root) != state.tree_hash_root():
+        if bytes(signed_block.message.state_root) != cached_root(state):
             raise ValueError("block state_root mismatch")
         self.state = state
         self.blocks.append(signed_block)

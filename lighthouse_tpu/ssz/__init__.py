@@ -15,6 +15,7 @@ from .hash import (  # noqa: F401
     mix_in_length,
     pack_bytes,
 )
+from .cached import CachedRoot, ChunkTreeCache, cached_root  # noqa: F401
 from .types import (  # noqa: F401
     Bitlist,
     Bitvector,

@@ -36,8 +36,15 @@ def get_beacon_proposer_index(state, preset: Preset, spec) -> int:
 
 
 def process_slot(state, preset: Preset):
-    """Cache state/block roots into the ring buffers (spec process_slot)."""
-    previous_state_root = state.tree_hash_root()
+    """Cache state/block roots into the ring buffers (spec process_slot).
+
+    The state root goes through the incremental tree-hash cache
+    (ssz/cached.py, reference consensus/cached_tree_hash): slot-to-slot the
+    state differs in a handful of fields, so the cached path re-hashes only
+    dirty merkle paths instead of the whole ~100k-validator tree."""
+    from ..ssz import cached_root
+
+    previous_state_root = cached_root(state)
     roots = list(state.state_roots)
     roots[state.slot % preset.slots_per_historical_root] = previous_state_root
     state.state_roots = tuple(roots)

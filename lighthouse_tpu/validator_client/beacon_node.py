@@ -7,6 +7,7 @@ are transport-agnostic (the reference's BN<->VC process boundary)."""
 from __future__ import annotations
 
 from ..chain.beacon_chain import BeaconChain
+from ..ssz import cached_root
 from ..pool import NaiveAggregationPool, OperationPool
 from ..state_transition import (
     BlockSignatureStrategy,
@@ -180,7 +181,7 @@ class InProcessBeaconNode:
             strategy=BlockSignatureStrategy.NO_VERIFICATION,
             verified_proposer_index=proposer,
         )
-        block.state_root = scratch.tree_hash_root()
+        block.state_root = cached_root(scratch)
         return block
 
     def publish_block(self, signed_block) -> bytes:
@@ -235,14 +236,16 @@ class InProcessBeaconNode:
         which subnets (duties_service/sync.rs poll)."""
         from ..chain.sync_committee_verification import (
             subnets_for_sync_validator,
+            sync_committee_positions,
         )
 
         state = self.chain.head_state
         if not hasattr(state, "current_sync_committee"):
             return []
+        table = sync_committee_positions(state, self.preset)
         out = []
         for idx in indices:
-            subnets = subnets_for_sync_validator(state, self.preset, idx)
+            subnets = subnets_for_sync_validator(state, self.preset, idx, table)
             if subnets:
                 out.append({"validator_index": idx, "subnets": subnets})
         return out

@@ -253,7 +253,6 @@ class ValidatorClient:
         node, duties = self._sync_duties(slot)
         if not duties:
             return
-        t = types_for(self.preset)
         state = node.signing_context()
         head_root = node.chain.head_root if hasattr(node, "chain") else None
         if head_root is None:
@@ -293,6 +292,8 @@ class ValidatorClient:
         t = types_for(self.preset)
         state = node.signing_context()
         head_root = node.chain.head_root if hasattr(node, "chain") else None
+        if head_root is None:
+            return
         for d in duties:
             pubkey = self._pubkey_for_index(d["validator_index"])
             if pubkey is None:

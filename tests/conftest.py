@@ -33,3 +33,7 @@ sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 from __graft_entry__ import _arm_compilation_cache  # noqa: E402
 
 _arm_compilation_cache()
+
+
+def pytest_configure(config):
+    config.addinivalue_line("markers", "slow: long-running scale benchmark")

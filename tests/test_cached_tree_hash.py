@@ -1,0 +1,157 @@
+"""Incremental tree hashing (ssz/cached.py).
+
+Mirrors the reference's cached_tree_hash test strategy
+(consensus/cached_tree_hash/src/lib.rs tests): differential equality of
+the cached root against the from-scratch root across random mutations,
+plus the headline speedup claim — epoch replay at large validator counts
+must get an order-of-magnitude state-root speedup from the cache.
+
+NOTE: no `from __future__ import annotations` — @container consumes live
+annotations (see types/containers.py header).
+"""
+
+import random
+import time
+
+import pytest
+
+from lighthouse_tpu.harness.chain import StateHarness
+from lighthouse_tpu.ssz import (
+    Bytes32,
+    ChunkTreeCache,
+    List,
+    Vector,
+    cached_root,
+    container,
+    merkleize,
+    uint64,
+)
+from lighthouse_tpu.state_transition import BlockSignatureStrategy
+from lighthouse_tpu.types import types_for
+from lighthouse_tpu.types.chain_spec import ChainSpec
+from lighthouse_tpu.types.containers import Validator
+from lighthouse_tpu.types.presets import MINIMAL
+
+
+def test_chunk_tree_cache_differential():
+    rng = random.Random(1234)
+    for limit in [1, 2, 3, 8, 64, 1 << 14]:
+        tc = ChunkTreeCache(limit)
+        chunks: list[bytes] = []
+        for step in range(60):
+            op = rng.random()
+            if op < 0.35 and len(chunks) < limit:
+                chunks.extend(
+                    rng.randbytes(32)
+                    for _ in range(min(rng.randrange(1, 6), limit - len(chunks)))
+                )
+            elif op < 0.55 and chunks:
+                del chunks[rng.randrange(len(chunks)) :]
+            elif chunks:
+                chunks[rng.randrange(len(chunks))] = rng.randbytes(32)
+            assert tc.update(list(chunks)) == merkleize(list(chunks), limit), (
+                limit,
+                step,
+            )
+
+
+def test_chunk_tree_cache_shrink_then_grow():
+    """Shrink paths must bubble zero-subtrees all the way up."""
+    tc = ChunkTreeCache(1 << 10)
+    full = [bytes([i]) * 32 for i in range(1, 200)]
+    tc.update(list(full))
+    for n in [199, 64, 63, 1, 0, 5, 128]:
+        cur = full[:n]
+        assert tc.update(list(cur)) == merkleize(list(cur), 1 << 10), n
+
+
+def test_cached_root_matches_fresh_on_container():
+    @container
+    class Rec:
+        a: uint64
+        b: Bytes32
+
+    @container
+    class Box:
+        nums: List(uint64, 1 << 12)
+        roots: Vector(Bytes32, 8)
+        recs: List(Rec.ssz_type, 1 << 8)
+
+    rng = random.Random(7)
+    box = Box.default()
+    for _ in range(40):
+        op = rng.randrange(5)
+        if op == 0:
+            box.nums = (*box.nums, rng.randrange(1 << 62))
+        elif op == 1 and box.nums:
+            ns = list(box.nums)
+            ns[rng.randrange(len(ns))] = rng.randrange(1 << 62)
+            box.nums = tuple(ns)
+        elif op == 2:
+            rs = list(box.roots)
+            rs[rng.randrange(8)] = rng.randbytes(32)
+            box.roots = tuple(rs)
+        elif op == 3:
+            box.recs = (*box.recs, Rec(a=rng.randrange(99), b=rng.randbytes(32)))
+        elif box.recs:
+            # in-place element mutation + re-tuple: the state-transition
+            # convention the cache's content keys must survive
+            rs = list(box.recs)
+            rs[rng.randrange(len(rs))].a = rng.randrange(99)
+            box.recs = tuple(rs)
+        assert cached_root(box) == box.tree_hash_root()
+
+
+def test_cached_root_across_epoch_replay():
+    """Every slot of a multi-epoch replay (incl. block processing and the
+    epoch transition) produces the same state root cached vs fresh."""
+    spec = ChainSpec.interop(altair_fork_epoch=1)
+    h = StateHarness(16, MINIMAL, spec, sign=False)
+    for slot in range(1, 2 * MINIMAL.slots_per_epoch + 4):
+        signed, _ = h.produce_block(slot)
+        h.apply_block(signed, strategy=BlockSignatureStrategy.NO_VERIFICATION)
+        assert cached_root(h.state) == h.state.tree_hash_root()
+
+
+@pytest.mark.slow
+def test_cached_root_speedup_at_scale():
+    """Reference parity claim (consensus/cached_tree_hash): with >=100k
+    validators, slot-to-slot state roots through the cache are at least an
+    order of magnitude faster than from-scratch merkleization."""
+    from lighthouse_tpu.types.chain_spec import FAR_FUTURE_EPOCH
+
+    n = 100_000
+    types = types_for(MINIMAL)
+    state = types.BeaconState.default()
+    rng = random.Random(9)
+    state.validators = tuple(
+        Validator(
+            pubkey=rng.randbytes(48),
+            withdrawal_credentials=rng.randbytes(32),
+            effective_balance=32 * 10**9,
+            exit_epoch=FAR_FUTURE_EPOCH,
+            withdrawable_epoch=FAR_FUTURE_EPOCH,
+        )
+        for _ in range(n)
+    )
+    state.balances = tuple(32 * 10**9 for _ in range(n))
+
+    t0 = time.perf_counter()
+    fresh_root = state.tree_hash_root()
+    fresh_s = time.perf_counter() - t0
+
+    assert cached_root(state) == fresh_root  # cold build
+    # the steady-state workload: a few balances change, everything else is
+    # identical — exactly what per-slot replay sees between blocks
+    bal = list(state.balances)
+    for i in rng.sample(range(n), 10):
+        bal[i] += 1
+    state.balances = tuple(bal)
+
+    t0 = time.perf_counter()
+    warm_root = cached_root(state)
+    warm_s = time.perf_counter() - t0
+    assert warm_root == state.tree_hash_root()
+    assert warm_s * 10 < fresh_s, (
+        f"cached warm root {warm_s:.3f}s not 10x faster than fresh {fresh_s:.3f}s"
+    )

@@ -136,3 +136,112 @@ class TestEth1Service:
         for dep in out:
             process_deposit(state, dep, MINIMAL, spec, ctxt)
         assert len(state.validators) == 8 + 3
+
+
+class TestJsonRpcBoundary:
+    """Reference parity (eth1/src/service.rs polls real JSON-RPC): the
+    service talks to an HTTP server over a socket, exercising ABI log
+    decoding, transport retries, and reorg rewind."""
+
+    def _spin(self):
+        from lighthouse_tpu.eth1 import (
+            Eth1RpcServer,
+            JsonRpcEth1Provider,
+            MockEth1Provider,
+        )
+
+        chain = MockEth1Provider()
+        server = Eth1RpcServer(chain).start()
+        provider = JsonRpcEth1Provider(server.url, backoff_s=0.01)
+        return chain, server, provider
+
+    def test_abi_roundtrip(self):
+        from lighthouse_tpu.eth1 import (
+            decode_deposit_log_data,
+            encode_deposit_log_data,
+        )
+
+        spec = ChainSpec.interop()
+        dd = make_deposit_data(SecretKey(77), 32 * 10**9, spec)
+        data = encode_deposit_log_data(dd, 42)
+        out, index = decode_deposit_log_data(data)
+        assert index == 42
+        assert bytes(out.pubkey) == bytes(dd.pubkey)
+        assert out.amount == dd.amount
+        assert bytes(out.signature) == bytes(dd.signature)
+
+    def test_service_over_http(self):
+        spec = ChainSpec.interop()
+        chain, server, provider = self._spin()
+        try:
+            d = make_deposit_data(SecretKey(3), 32 * 10**9, spec)
+            chain.add_block(100, [d])
+            for ts in range(101, 106):
+                chain.add_block(ts)
+            svc = Eth1Service(provider, follow_distance=2)
+            svc.update()
+            h = StateHarness(8, MINIMAL, spec, sign=False)
+            vote = svc.eth1_data_for_block(h.state)
+            assert vote.deposit_count == 1
+            assert vote.block_hash == chain.blocks[-3].hash
+            assert len(svc.deposit_tree.leaves) == 1
+        finally:
+            server.stop()
+
+    def test_transport_retries(self):
+        spec = ChainSpec.interop()
+        chain, server, provider = self._spin()
+        try:
+            chain.add_block(100, [make_deposit_data(SecretKey(4), 32 * 10**9, spec)])
+            server.fail_next = 2  # first two requests 503; retries recover
+            svc = Eth1Service(provider, follow_distance=0)
+            svc.update()
+            assert len(svc.block_cache) == 1
+        finally:
+            server.stop()
+
+    def test_reorg_rewinds_deposits(self):
+        spec = ChainSpec.interop()
+        chain, server, provider = self._spin()
+        try:
+            d1 = make_deposit_data(SecretKey(5), 32 * 10**9, spec)
+            d2 = make_deposit_data(SecretKey(6), 32 * 10**9, spec)
+            chain.add_block(100, [d1])
+            chain.add_block(101, [d2])
+            svc = Eth1Service(provider, follow_distance=0)
+            svc.update()
+            assert len(svc.deposit_tree.leaves) == 2
+
+            # reorg drops block 1 (and d2); replacement carries d3
+            chain.reorg(1)
+            d3 = make_deposit_data(SecretKey(7), 32 * 10**9, spec)
+            chain.add_block(102, [d3])
+            svc.update()
+            assert len(svc.deposit_tree.leaves) == 2
+            assert svc.block_cache[-1].hash == chain.blocks[-1].hash
+            # tree content reflects d1,d3 — not the reorged-out d2
+            from lighthouse_tpu.eth1 import DepositDataTree
+
+            fresh = DepositDataTree()
+            fresh.push(d1)
+            fresh.push(d3)
+            assert svc.deposit_tree.root() == fresh.root()
+        finally:
+            server.stop()
+
+
+class TestMockProviderReorg:
+    def test_service_rewind_without_http(self):
+        spec = ChainSpec.interop()
+        provider = MockEth1Provider()
+        d1 = make_deposit_data(SecretKey(8), 32 * 10**9, spec)
+        provider.add_block(100, [d1])
+        provider.add_block(101)
+        svc = Eth1Service(provider, follow_distance=0)
+        svc.update()
+        assert len(svc.block_cache) == 2
+        provider.reorg(2)  # drop both, incl. the deposit
+        provider.add_block(103)
+        svc.update()
+        assert len(svc.deposit_tree.leaves) == 0
+        assert [b.hash for b in svc.block_cache] == [provider.blocks[0].hash]

@@ -94,9 +94,24 @@ class BeaconChain:
         store.put_chain_item(
             b"block_post_state:" + genesis_root, genesis_state_root
         )
+        store.put_chain_item(b"head_block_root", genesis_root)
+        store.put_chain_item(b"head_state_root", genesis_state_root)
         self.head_root = genesis_root
         self.head_state = clone_state(genesis_state)
         self._states: dict[bytes, object] = {genesis_root: genesis_state}
+        # backfill anchor (historical_blocks.rs oldest_block_slot): the
+        # earliest block this node holds; genesis start = nothing to fill.
+        # Persisted so from_store restarts don't re-backfill known history.
+        self.oldest_block_root = genesis_root
+        self.oldest_block_slot = genesis_state.slot
+        self.oldest_block_parent = bytes(
+            genesis_state.latest_block_header.parent_root
+        )
+        store.put_chain_item(b"oldest_block_root", genesis_root)
+        store.put_chain_item(
+            b"oldest_block_meta",
+            genesis_state.slot.to_bytes(8, "little") + self.oldest_block_parent,
+        )
         # optional engine handle (reference beacon_chain.execution_layer);
         # None = pre-merge / no EL configured
         self.execution_layer = None
@@ -106,6 +121,67 @@ class BeaconChain:
     def emit(self, kind: str, payload: dict) -> None:
         for sink in self.event_sinks:
             sink(kind, payload)
+
+    # -- alternative genesis resolution (client/src/config.rs:15-40) --------
+
+    @classmethod
+    def from_anchor(
+        cls,
+        store: HotColdDB,
+        anchor_state,
+        anchor_block,
+        preset: Preset,
+        spec,
+        slot_clock=None,
+    ) -> "BeaconChain":
+        """Checkpoint-sync start (ClientGenesis::CheckpointSyncUrl /
+        WeakSubjSszBytes, client/src/builder.rs:206-340): initialize from a
+        finalized (state, block) pair instead of genesis. History below the
+        anchor is absent until backfill fills it."""
+        block = anchor_block.message
+        block_root = block.tree_hash_root()
+        state_root = cached_root(anchor_state)
+        if bytes(block.state_root) != state_root:
+            raise BlockError("anchor state does not match anchor block")
+        chain = cls(store, anchor_state, preset, spec, slot_clock=slot_clock)
+        if chain.genesis_block_root != block_root:
+            raise BlockError("anchor state header does not match anchor block")
+        store.put_block(block_root, anchor_block)
+        chain.oldest_block_root = block_root
+        chain.oldest_block_slot = block.slot
+        chain.oldest_block_parent = bytes(block.parent_root)
+        store.put_chain_item(b"oldest_block_root", block_root)
+        store.put_chain_item(
+            b"oldest_block_meta",
+            block.slot.to_bytes(8, "little") + chain.oldest_block_parent,
+        )
+        return chain
+
+    @classmethod
+    def from_store(
+        cls, store: HotColdDB, preset: Preset, spec, slot_clock=None
+    ) -> "BeaconChain":
+        """Node-restart resume (ClientGenesis::FromStore): reload the
+        persisted head and continue."""
+        head_root = store.get_chain_item(b"head_block_root")
+        state_root = store.get_chain_item(b"head_state_root")
+        if head_root is None or state_root is None:
+            raise BlockError("store holds no persisted chain")
+        state = store.get_full_state(state_root)
+        if state is None:
+            raise BlockError("persisted head state missing")
+        # snapshot the persisted anchor BEFORE __init__ overwrites it with
+        # the resumed head's (head != true anchor after any sync progress)
+        oldest = store.get_chain_item(b"oldest_block_root")
+        meta = store.get_chain_item(b"oldest_block_meta")
+        chain = cls(store, state, preset, spec, slot_clock=slot_clock)
+        if oldest is not None and meta is not None:
+            chain.oldest_block_root = oldest
+            chain.oldest_block_slot = int.from_bytes(meta[:8], "little")
+            chain.oldest_block_parent = meta[8:]
+            store.put_chain_item(b"oldest_block_root", oldest)
+            store.put_chain_item(b"oldest_block_meta", meta)
+        return chain
 
     # -- time ----------------------------------------------------------------
 
@@ -195,6 +271,9 @@ class BeaconChain:
         # the merkle layers would ~double per-state memory for nothing
         state.__dict__.pop("_lh_tree_cache", None)
         self.store.put_state(state_root, state)
+        self.store.put_chain_item(
+            b"block_post_state:" + block_root, state_root
+        )
         self._states[block_root] = state
 
         self.fork_choice.on_block(
@@ -255,6 +334,13 @@ class BeaconChain:
             # duty lookahead); aliasing the cached post-state would corrupt
             # the canonical chain (reference snapshots in canonical_head.rs).
             self.head_state = clone_state(self._states[head])
+            # persist the head pointer for FromStore restart resume
+            self.store.put_chain_item(b"head_block_root", head)
+            state_root = self.store.get_chain_item(
+                b"block_post_state:" + head
+            )
+            if state_root is not None:
+                self.store.put_chain_item(b"head_state_root", state_root)
         return head
 
     def head(self):

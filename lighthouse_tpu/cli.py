@@ -39,26 +39,60 @@ def _add_network_args(p):
 # --- beacon node ------------------------------------------------------------
 
 
-def build_beacon_node(args):
-    """ClientBuilder equivalent (reference client/src/builder.rs:56):
-    store -> genesis -> chain -> pools -> API server."""
+def resolve_genesis(args, store, preset, spec):
+    """ClientGenesis resolution (reference client/src/config.rs:15-40 +
+    builder.rs:206-340): interop keys, FromStore restart resume, or a
+    weak-subjectivity checkpoint (finalized state+block SSZ)."""
     from .chain.beacon_chain import BeaconChain
-    from .http_api import BeaconApi, BeaconApiServer
-    from .store.hot_cold import HotColdDB
-    from .store.kv import FileStore, MemoryStore
     from .types import interop_genesis_state
     from .utils.slot_clock import SystemSlotClock
-    from .validator_client.beacon_node import InProcessBeaconNode
 
-    preset, spec = _spec_preset(args)
-    kv = FileStore(args.datadir) if args.datadir else MemoryStore()
-    store = HotColdDB(kv, preset, spec)
+    mode = getattr(args, "genesis", "interop")
+    if mode == "resume":
+        chain = BeaconChain.from_store(store, preset, spec)
+        chain.slot_clock = SystemSlotClock(
+            chain.head_state.genesis_time, spec.seconds_per_slot
+        )
+        return chain
+    if mode == "checkpoint":
+        from .types import decode_state_any_fork, decode_block_any_fork
+
+        if not getattr(args, "checkpoint_state", None) or not getattr(
+            args, "checkpoint_block", None
+        ):
+            raise SystemExit(
+                "--genesis checkpoint requires --checkpoint-state and "
+                "--checkpoint-block"
+            )
+        with open(args.checkpoint_state, "rb") as f:
+            state = decode_state_any_fork(f.read(), preset)
+        with open(args.checkpoint_block, "rb") as f:
+            block = decode_block_any_fork(f.read(), preset)
+        chain = BeaconChain.from_anchor(store, state, block, preset, spec)
+        chain.slot_clock = SystemSlotClock(
+            state.genesis_time, spec.seconds_per_slot
+        )
+        return chain
     genesis = interop_genesis_state(
         args.interop_validators, preset, spec,
         genesis_time=args.genesis_time or int(time.time()),
     )
     clock = SystemSlotClock(genesis.genesis_time, spec.seconds_per_slot)
-    chain = BeaconChain(store, genesis, preset, spec, slot_clock=clock)
+    return BeaconChain(store, genesis, preset, spec, slot_clock=clock)
+
+
+def build_beacon_node(args):
+    """ClientBuilder equivalent (reference client/src/builder.rs:56):
+    store -> genesis -> chain -> pools -> API server."""
+    from .http_api import BeaconApi, BeaconApiServer
+    from .store.hot_cold import HotColdDB
+    from .store.kv import FileStore, MemoryStore
+    from .validator_client.beacon_node import InProcessBeaconNode
+
+    preset, spec = _spec_preset(args)
+    kv = FileStore(args.datadir) if args.datadir else MemoryStore()
+    store = HotColdDB(kv, preset, spec)
+    chain = resolve_genesis(args, store, preset, spec)
     node = InProcessBeaconNode(chain)
     api = BeaconApi(node)
     server = BeaconApiServer(api, port=args.http_port)
@@ -231,6 +265,13 @@ def main(argv=None) -> int:
     bn.add_argument("--http-port", type=int, default=0)
     bn.add_argument("--interop-validators", type=int, default=64)
     bn.add_argument("--genesis-time", type=int, default=None)
+    bn.add_argument("--genesis", default="interop",
+                    choices=["interop", "resume", "checkpoint"],
+                    help="genesis resolution (ClientGenesis equivalent)")
+    bn.add_argument("--checkpoint-state", default=None,
+                    help="SSZ file: finalized BeaconState anchor")
+    bn.add_argument("--checkpoint-block", default=None,
+                    help="SSZ file: finalized SignedBeaconBlock anchor")
     bn.add_argument("--dry-run", action="store_true")
     bn.set_defaults(fn=cmd_bn)
 

@@ -102,6 +102,10 @@ class NetworkNode:
         bus.register_rpc(peer_id, BLOCKS_BY_RANGE, self._rpc_blocks_by_range)
         bus.register_rpc(peer_id, BLOCKS_BY_ROOT, self._rpc_blocks_by_root)
 
+        from .sync import SyncManager
+
+        self.sync_manager = SyncManager(self)
+
     # -- scoring (peerdb/score.rs) ------------------------------------------
 
     def penalize(self, peer: str, amount: int = GOSSIP_PENALTY) -> None:
@@ -266,17 +270,24 @@ class NetworkNode:
         }
 
     def _rpc_blocks_by_range(self, payload, _peer):
-        start, count = payload["start_slot"], payload["count"]
+        start = payload["start_slot"]
+        count = min(payload["count"], 64)  # rpc/rate_limiter.rs quota cap
         out = []
-        # walk the canonical chain from head backwards
+        # walk the canonical chain from head backwards through the STORE
+        # (not the in-memory state map) so finalized/backfilled history
+        # below the pruning boundary is served too
         root = self.chain.head_root
         chain = []
-        while root in self.chain._states:
+        while True:
             blk = self.chain.store.get_block_any_temperature(root)
             if blk is None:
                 break
+            if blk.message.slot < start:
+                break
             chain.append(blk)
             root = bytes(blk.message.parent_root)
+            if not any(root):
+                break
         for blk in reversed(chain):
             if start <= blk.message.slot < start + count:
                 out.append(blk)
@@ -290,34 +301,15 @@ class NetworkNode:
                 out.append(blk)
         return out
 
-    # -- sync (sync/manager.rs + range_sync) --------------------------------
+    # -- sync (sync/manager.rs + range_sync + backfill_sync) ----------------
 
     def sync_with(self, peer: str) -> int:
-        """Range-sync from `peer` until our head reaches theirs; returns
-        blocks imported (the reference's forward range sync)."""
-        status = self.bus.request(self.peer_id, peer, STATUS_PROTOCOL, {})
-        imported = 0
-        while self.chain.head_state.slot < status["head_slot"]:
-            start = self.chain.head_state.slot + 1
-            blocks = self.bus.request(
-                self.peer_id,
-                peer,
-                BLOCKS_BY_RANGE,
-                {"start_slot": start, "count": 32},
-            )
-            if not blocks:
-                break
-            progressed = False
-            for blk in blocks:
-                try:
-                    self.chain.slot_clock.set_slot(
-                        max(self.chain.current_slot, blk.message.slot)
-                    )
-                    self.chain.process_block(blk)
-                    imported += 1
-                    progressed = True
-                except BlockError:
-                    continue
-            if not progressed:
-                break
-        return imported
+        """Single-peer forward range sync (kept for callers that target a
+        specific peer; multi-peer logic lives in SyncManager)."""
+        return self.sync_manager.sync_from(peer)
+
+    def range_sync(self) -> int:
+        return self.sync_manager.range_sync()
+
+    def backfill_sync(self) -> int:
+        return self.sync_manager.backfill_sync()

@@ -37,6 +37,8 @@ from .containers import (  # noqa: F401
     Validator,
     VoluntaryExit,
     block_classes_for,
+    decode_block_any_fork,
+    decode_state_any_fork,
     state_class_for,
     types_for,
 )

@@ -488,3 +488,29 @@ def state_class_for(t: SimpleNamespace, fork: str):
     if fork == "bellatrix":
         return t.BeaconStateBellatrix
     raise ValueError(f"unsupported fork {fork!r}")
+
+
+def decode_state_any_fork(ssz_bytes: bytes, preset: Preset):
+    """Decode a BeaconState of unknown fork by trying newest-first (the
+    reference sniffs the fork from the state's slot via superstruct;
+    SSZ layouts differ enough that exactly one variant decodes)."""
+    t = types_for(preset)
+    last_err = None
+    for fork in ("bellatrix", "altair", "phase0"):
+        try:
+            return state_class_for(t, fork).from_ssz_bytes(ssz_bytes)
+        except Exception as e:  # noqa: BLE001 -- wrong-fork decode fails
+            last_err = e
+    raise ValueError(f"undecodable BeaconState: {last_err}")
+
+
+def decode_block_any_fork(ssz_bytes: bytes, preset: Preset):
+    """Decode a SignedBeaconBlock of unknown fork, newest-first."""
+    t = types_for(preset)
+    last_err = None
+    for fork in ("bellatrix", "altair", "phase0"):
+        try:
+            return block_classes_for(t, fork)[1].from_ssz_bytes(ssz_bytes)
+        except Exception as e:  # noqa: BLE001
+            last_err = e
+    raise ValueError(f"undecodable SignedBeaconBlock: {last_err}")

@@ -42,6 +42,22 @@ class ExecutionLayer:
     def __init__(self, engine, suggested_fee_recipient: bytes = b"\x00" * 20):
         self.engine = engine
         self.suggested_fee_recipient = suggested_fee_recipient
+        # per-proposer fee recipients pushed by VCs (reference
+        # execution_layer proposer_preparation_data, fed by the VC's
+        # preparation_service.rs prepare_beacon_proposer calls)
+        self.proposer_preparations: dict[int, bytes] = {}
+
+    def update_proposer_preparation(
+        self, validator_index: int, fee_recipient: bytes
+    ) -> None:
+        self.proposer_preparations[validator_index] = bytes(fee_recipient)
+
+    def fee_recipient_for(self, validator_index: int | None) -> bytes:
+        if validator_index is None:
+            return self.suggested_fee_recipient
+        return self.proposer_preparations.get(
+            validator_index, self.suggested_fee_recipient
+        )
 
     # -- verification path (block import) -----------------------------------
 

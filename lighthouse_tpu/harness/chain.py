@@ -252,6 +252,7 @@ class StateHarness:
                 parent_hash,
                 compute_timestamp_at_slot(state, slot, self.spec),
                 bytes(get_randao_mix(state, epoch, self.preset)),
+                fee_recipient=self.execution_layer.fee_recipient_for(proposer),
             )
 
         block = block_cls(

@@ -254,6 +254,20 @@ class BeaconApi:
             )
         return {}
 
+    def prepare_beacon_proposer(self, preparations: list[dict]) -> dict:
+        """POST /eth/v1/validator/prepare_beacon_proposer: fee recipients
+        per proposer for payload builds (preparation_service.rs feed)."""
+        self.node.prepare_proposers(
+            [
+                {
+                    "validator_index": int(p["validator_index"]),
+                    "fee_recipient": unhex(p["fee_recipient"]),
+                }
+                for p in preparations
+            ]
+        )
+        return {}
+
     # -- node namespace ------------------------------------------------------
 
     def get_health(self) -> int:

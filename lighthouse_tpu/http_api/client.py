@@ -186,3 +186,15 @@ class BeaconNodeHttpClient:
             "/eth/v1/validator/aggregate_and_proofs",
             ["0x" + signed_aggregate.as_ssz_bytes().hex()],
         )
+
+    def prepare_proposers(self, preparations) -> None:
+        self._post(
+            "/eth/v1/validator/prepare_beacon_proposer",
+            [
+                {
+                    "validator_index": str(p["validator_index"]),
+                    "fee_recipient": "0x" + bytes(p["fee_recipient"]).hex(),
+                }
+                for p in preparations
+            ],
+        )

@@ -139,6 +139,10 @@ class BeaconApiServer:
                         r"^/eth/v1/validator/aggregate_and_proofs$",
                         lambda m: api.post_aggregate_and_proofs(self._body()),
                     ),
+                    (
+                        r"^/eth/v1/validator/prepare_beacon_proposer$",
+                        lambda m: api.prepare_beacon_proposer(self._body()),
+                    ),
                 ]
 
                 if method == "GET" and path == "/eth/v1/node/health":

@@ -86,6 +86,17 @@ class InProcessBeaconNode:
             if bytes(v.pubkey) in wanted
         }
 
+    def prepare_proposers(self, preparations) -> None:
+        """Record proposer fee recipients with the execution layer
+        (/eth/v1/validator/prepare_beacon_proposer seat)."""
+        el = self.chain.execution_layer
+        if el is None:
+            return
+        for prep in preparations:
+            el.update_proposer_preparation(
+                int(prep["validator_index"]), bytes(prep["fee_recipient"])
+            )
+
     # -- duties (the endpoints duties_service.rs:356-765 polls) -------------
 
     def get_proposer_duties(self, epoch: int) -> list[tuple[int, int]]:
@@ -160,6 +171,29 @@ class InProcessBeaconNode:
             prev_root = state.latest_block_header.tree_hash_root()
             body.sync_aggregate = self.sync_contribution_pool.get_sync_aggregate(
                 t, slot - 1, prev_root
+            )
+        el = self.chain.execution_layer
+        if hasattr(body, "execution_payload") and el is not None:
+            # payload build honors the proposer's prepared fee recipient
+            # (preparation_service.rs -> execution_layer get_payload)
+            from ..state_transition.per_block import (
+                compute_timestamp_at_slot,
+                is_merge_transition_complete,
+            )
+            from ..types.helpers import get_randao_mix
+
+            if is_merge_transition_complete(state):
+                parent_hash = bytes(
+                    state.latest_execution_payload_header.block_hash
+                )
+            else:
+                parent_hash = el.engine.genesis_hash
+            epoch = compute_epoch_at_slot(slot, self.preset)
+            body.execution_payload = el.get_payload(
+                parent_hash,
+                compute_timestamp_at_slot(state, slot, self.spec),
+                bytes(get_randao_mix(state, epoch, self.preset)),
+                fee_recipient=el.fee_recipient_for(proposer),
             )
 
         block = block_cls(

@@ -127,6 +127,7 @@ class ValidatorClient:
         self.sync_contributions_published = 0
         self.doppelganger_detected: list[bytes] = []
         self._dg_start: dict[bytes, int] = {}
+        self._prepared_epochs: set[int] = set()
 
     def _pubkey_for_index(self, index: int) -> bytes | None:
         for pk in self.store.voting_pubkeys():
@@ -140,11 +141,34 @@ class ValidatorClient:
         epoch = compute_epoch_at_slot(slot, self.preset)
         self.duties.poll(epoch)
         self._doppelganger_scan(epoch)
+        self._preparation_duty(epoch)
         self._block_duty(slot)
         self._attestation_duty(slot)
         self._sync_committee_duty(slot)
         self._aggregation_duty(slot)
         self._sync_aggregation_duty(slot)
+
+    # -- preparation / fee recipients (preparation_service.rs) ---------------
+
+    def _preparation_duty(self, epoch: int) -> None:
+        """Once per epoch, push proposer preparations (validator index +
+        fee recipient) to the BN so payload builds credit the right
+        address."""
+        if epoch in self._prepared_epochs:
+            return
+        preps = []
+        for pk in self.store.voting_pubkeys():
+            idx = self.store.validator_index(pk)
+            fee = self.store.fee_recipient_for(pk)
+            if idx is None or fee is None:
+                continue  # unconfigured recipients are not pushed
+            preps.append({"validator_index": idx, "fee_recipient": fee})
+        if not preps:
+            return
+        node = self.nodes.best()
+        if hasattr(node, "prepare_proposers"):
+            node.prepare_proposers(preps)
+            self._prepared_epochs.add(epoch)
 
     def _block_duty(self, slot: int) -> None:
         proposer = self.duties.block_proposal_duty(slot, self.preset)

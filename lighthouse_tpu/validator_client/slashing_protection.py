@@ -11,6 +11,7 @@ from __future__ import annotations
 
 import json
 import sqlite3
+import threading
 
 _SCHEMA = """
 CREATE TABLE IF NOT EXISTS validators (
@@ -39,7 +40,11 @@ class NotSafe(ValueError):
 
 class SlashingDatabase:
     def __init__(self, path: str = ":memory:"):
-        self.conn = sqlite3.connect(path)
+        # one shared connection guarded by a lock: the keymanager HTTP API
+        # calls in from handler threads (the reference serializes through
+        # rusqlite's pooled connections, slashing_database.rs)
+        self.conn = sqlite3.connect(path, check_same_thread=False)
+        self._lock = threading.Lock()
         self.conn.executescript(_SCHEMA)
         self.conn.commit()
 
@@ -49,7 +54,7 @@ class SlashingDatabase:
     # -- registration --------------------------------------------------------
 
     def register_validator(self, pubkey_hex: str) -> int:
-        with self.conn:
+        with self._lock, self.conn:
             return self._register_in_txn(pubkey_hex)
 
     def _validator_id(self, pubkey_hex: str) -> int:
@@ -66,7 +71,7 @@ class SlashingDatabase:
         self, pubkey_hex: str, slot: int, signing_root: bytes
     ) -> None:
         vid = self._validator_id(pubkey_hex)
-        with self.conn:  # atomic check-and-insert
+        with self._lock, self.conn:  # atomic check-and-insert
             row = self.conn.execute(
                 "SELECT signing_root FROM signed_blocks "
                 "WHERE validator_id = ? AND slot = ?",
@@ -103,7 +108,7 @@ class SlashingDatabase:
         if source_epoch > target_epoch:
             raise NotSafe("attestation source after target")
         vid = self._validator_id(pubkey_hex)
-        with self.conn:
+        with self._lock, self.conn:
             # double vote: same target, different root
             row = self.conn.execute(
                 "SELECT signing_root FROM signed_attestations "
@@ -211,7 +216,7 @@ class SlashingDatabase:
         # slashing_database.rs import_interchange_info).
         # `with self.conn` rolls the whole transaction back on any raise:
         # a slashable conflict anywhere means NO partial import.
-        with self.conn:
+        with self._lock, self.conn:
             for record in interchange.get("data", []):
                 pubkey = record["pubkey"].removeprefix("0x")
                 vid = self._register_in_txn(pubkey)

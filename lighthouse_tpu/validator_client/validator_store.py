@@ -52,6 +52,12 @@ class ValidatorStore:
         self._methods: dict[bytes, LocalKeystore] = {}
         self._index_by_pubkey: dict[bytes, int] = {}
         self._doppelganger_hold: dict[bytes, bool] = {}
+        # fee recipients (preparation_service.rs): per-validator override
+        # over a process-wide default; None = not configured, and the
+        # preparation service skips unconfigured validators (pushing a
+        # zero address would burn fees and clobber the EL's own default)
+        self.default_fee_recipient: bytes | None = None
+        self._fee_recipients: dict[bytes, bytes] = {}
 
     # -- key management (initialized_validators.rs) -------------------------
 
@@ -79,6 +85,32 @@ class ValidatorStore:
 
     def release_doppelganger(self, pubkey: bytes) -> None:
         self._doppelganger_hold[bytes(pubkey)] = False
+
+    def set_fee_recipient(self, pubkey: bytes, address: bytes) -> None:
+        self._fee_recipients[bytes(pubkey)] = bytes(address)
+
+    def fee_recipient_for(self, pubkey: bytes) -> bytes | None:
+        return self._fee_recipients.get(
+            bytes(pubkey), self.default_fee_recipient
+        )
+
+    def has_validator(self, pubkey: bytes) -> bool:
+        return bytes(pubkey) in self._methods
+
+    def signing_method(self, pubkey: bytes):
+        return self._methods.get(bytes(pubkey))
+
+    def remove_validator(self, pubkey: bytes) -> bool:
+        """Drop a validator and all its per-key state (keymanager DELETE);
+        returns False if unknown."""
+        pk = bytes(pubkey)
+        if pk not in self._methods:
+            return False
+        del self._methods[pk]
+        self._index_by_pubkey.pop(pk, None)
+        self._doppelganger_hold.pop(pk, None)
+        self._fee_recipients.pop(pk, None)
+        return True
 
     def _method(self, pubkey: bytes) -> LocalKeystore:
         m = self._methods.get(bytes(pubkey))

@@ -1,0 +1,208 @@
+"""VC completeness extras: web3signer remote signing over real HTTP,
+keymanager API (list/import/delete + auth), preparation/fee-recipient
+service into the execution layer (coverage roles of reference
+testing/web3signer_tests, validator_client/src/http_api tests, and
+preparation_service.rs)."""
+
+import json
+
+import pytest
+
+from lighthouse_tpu.crypto.bls import SecretKey, set_backend
+from lighthouse_tpu.crypto.keystore import Keystore
+from lighthouse_tpu.types import ChainSpec, MINIMAL, interop_secret_key
+from lighthouse_tpu.validator_client import (
+    KeymanagerApi,
+    KeymanagerServer,
+    LocalKeystore,
+    ValidatorStore,
+    Web3SignerError,
+    Web3SignerMethod,
+    Web3SignerServer,
+)
+
+SPEC = ChainSpec.interop()
+
+
+@pytest.fixture(autouse=True)
+def _cpu_backend():
+    set_backend("cpu")
+    yield
+    set_backend("jax_tpu")
+
+
+class TestWeb3Signer:
+    def test_remote_signature_matches_local(self):
+        sk = interop_secret_key(0)
+        server = Web3SignerServer([sk]).start()
+        try:
+            method = Web3SignerMethod(server.url, sk.public_key())
+            root = b"\x5a" * 32
+            assert (
+                method.sign(root).to_bytes() == sk.sign(root).to_bytes()
+            )
+        finally:
+            server.stop()
+
+    def test_store_signs_through_remote(self):
+        """ValidatorStore treats a Web3SignerMethod exactly like a local
+        keystore: slashing protection still gates, roots computed locally."""
+        from lighthouse_tpu.types import interop_genesis_state
+        from lighthouse_tpu.types.containers import (
+            AttestationData,
+            Checkpoint,
+        )
+
+        sk = interop_secret_key(1)
+        server = Web3SignerServer([sk]).start()
+        try:
+            store = ValidatorStore(MINIMAL, SPEC)
+            store.add_validator(Web3SignerMethod(server.url, sk.public_key()))
+            state = interop_genesis_state(4, MINIMAL, SPEC)
+            data = AttestationData(
+                slot=1,
+                index=0,
+                beacon_block_root=bytes(32),
+                source=Checkpoint(epoch=0, root=bytes(32)),
+                target=Checkpoint(epoch=1, root=bytes(32)),
+            )
+            pk = sk.public_key().to_bytes()
+            sig = store.sign_attestation(pk, data, state)
+            assert len(sig.to_bytes()) == 96
+            # double-vote still blocked by the local slashing DB
+            from lighthouse_tpu.validator_client import NotSafe
+
+            data2 = AttestationData(
+                slot=1,
+                index=0,
+                beacon_block_root=b"\x01" * 32,
+                source=Checkpoint(epoch=0, root=bytes(32)),
+                target=Checkpoint(epoch=1, root=bytes(32)),
+            )
+            with pytest.raises(NotSafe):
+                store.sign_attestation(pk, data2, state)
+        finally:
+            server.stop()
+
+    def test_unreachable_signer_raises(self):
+        sk = interop_secret_key(2)
+        method = Web3SignerMethod(
+            "http://127.0.0.1:1", sk.public_key(), timeout_s=0.2
+        )
+        with pytest.raises(Web3SignerError):
+            method.sign(b"\x00" * 32)
+
+
+class TestKeymanager:
+    def _store_with_key(self):
+        store = ValidatorStore(MINIMAL, SPEC)
+        store.add_validator(LocalKeystore(interop_secret_key(3)))
+        return store
+
+    def test_list_import_delete_roundtrip(self):
+        store = self._store_with_key()
+        api = KeymanagerApi(store, bytes(32))
+        assert len(api.list_keystores()["data"]) == 1
+
+        # import a new keystore
+        sk = SecretKey(0xC0FFEE)
+        ks = Keystore.encrypt(sk, "pass123", kdf="pbkdf2")
+        out = api.import_keystores(
+            {"keystores": [ks.to_json()], "passwords": ["pass123"]}
+        )
+        assert out["data"][0]["status"] == "imported"
+        assert len(api.list_keystores()["data"]) == 2
+        # re-import is a duplicate
+        out = api.import_keystores(
+            {"keystores": [ks.to_json()], "passwords": ["pass123"]}
+        )
+        assert out["data"][0]["status"] == "duplicate"
+
+        # delete returns slashing data
+        pk_hex = "0x" + sk.public_key().to_bytes().hex()
+        out = api.delete_keystores({"pubkeys": [pk_hex]})
+        assert out["data"][0]["status"] == "deleted"
+        assert "slashing_protection" in out
+        assert len(api.list_keystores()["data"]) == 1
+
+    def test_http_server_requires_token(self):
+        import urllib.error
+        import urllib.request
+
+        store = self._store_with_key()
+        api = KeymanagerApi(store, bytes(32))
+        server = KeymanagerServer(api).start()
+        try:
+            req = urllib.request.Request(server.url + "/eth/v1/keystores")
+            with pytest.raises(urllib.error.HTTPError) as e:
+                urllib.request.urlopen(req)
+            # unauthorized without the bearer token
+            assert e.value.code == 401
+
+            req = urllib.request.Request(
+                server.url + "/eth/v1/keystores",
+                headers={"Authorization": f"Bearer {api.api_token}"},
+            )
+            with urllib.request.urlopen(req) as resp:
+                body = json.loads(resp.read())
+            assert len(body["data"]) == 1
+        finally:
+            server.stop()
+
+
+class TestPreparationService:
+    def test_fee_recipient_reaches_payload(self):
+        """The VC pushes fee recipients; blocks produced for that proposer
+        carry them in the execution payload (preparation_service.rs end
+        to end)."""
+        set_backend("fake")
+        from lighthouse_tpu.execution_layer import (
+            ExecutionLayer,
+            MockExecutionEngine,
+        )
+        from lighthouse_tpu.harness.beacon_chain_harness import (
+            BeaconChainHarness,
+        )
+        from lighthouse_tpu.types import types_for
+        from lighthouse_tpu.validator_client import (
+            BeaconNodeFallback,
+            InProcessBeaconNode,
+            ValidatorClient,
+        )
+
+        spec = ChainSpec.interop(
+            altair_fork_epoch=1, bellatrix_fork_epoch=2
+        )
+        t = types_for(MINIMAL)
+        el = ExecutionLayer(MockExecutionEngine(t))
+        h = BeaconChainHarness(16, MINIMAL, spec, execution_layer=el)
+        node = InProcessBeaconNode(h.chain)
+        store = ValidatorStore(MINIMAL, spec)
+        fee = b"\xfe" * 20
+        for i in range(16):
+            sk = interop_secret_key(i)
+            store.add_validator(LocalKeystore(sk), validator_index=i)
+            store.set_fee_recipient(sk.public_key().to_bytes(), fee)
+        vc = ValidatorClient(store, BeaconNodeFallback([node]), MINIMAL, spec)
+        h.chain.slot_clock.set_slot(1)
+        vc.on_slot(1)  # preparation duty runs here
+        assert el.proposer_preparations  # all our validators prepared
+        assert all(v == fee for v in el.proposer_preparations.values())
+
+        # cross into bellatrix; payload-bearing blocks use the recipient
+        h.extend_chain(3 * MINIMAL.slots_per_epoch)
+        head = h.chain.store.get_block_any_temperature(h.chain.head_root)
+        assert type(head).fork_name == "bellatrix"
+        assert (
+            bytes(head.message.body.execution_payload.fee_recipient) == fee
+        )
+
+        # the VC's own proposal path (InProcessBeaconNode.produce_block)
+        # also builds a payload crediting the prepared recipient
+        from lighthouse_tpu.crypto.bls import INFINITY_SIGNATURE
+
+        block = node.produce_block(
+            h.chain.head_state.slot + 1, INFINITY_SIGNATURE
+        )
+        assert type(block).fork_name == "bellatrix"
+        assert bytes(block.body.execution_payload.fee_recipient) == fee

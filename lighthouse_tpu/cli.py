@@ -94,6 +94,22 @@ def build_beacon_node(args):
     store = HotColdDB(kv, preset, spec)
     chain = resolve_genesis(args, store, preset, spec)
     node = InProcessBeaconNode(chain)
+    # optional wire networking (lighthouse_network seat): a TCP listener
+    # plus bootnode discovery turns this process into a networked peer
+    if getattr(args, "listen_port", None) is not None or getattr(
+        args, "bootnode", None
+    ):
+        from .network import NetworkNode, WireBus
+
+        bus = WireBus(preset)
+        peer_id = getattr(args, "peer_id", None) or f"bn-{id(chain) & 0xFFFF}"
+        node.network = NetworkNode(peer_id, chain, bus)
+        bus.listen(peer_id, getattr(args, "listen_port", 0) or 0)
+        if getattr(args, "bootnode", None):
+            host, _, port = args.bootnode.partition(":")
+            bus.bootstrap((host, int(port)))
+            node.network.range_sync()
+        node.wire_bus = bus
     api = BeaconApi(node)
     server = BeaconApiServer(api, port=args.http_port)
     return node, server
@@ -111,6 +127,10 @@ def cmd_bn(args):
         while True:  # notifier loop (client/src/notifier.rs)
             time.sleep(node.spec.seconds_per_slot)
             node.chain.on_tick()
+            if hasattr(node, "network"):
+                # drain gossip work queued by the wire listener threads
+                # (the BeaconProcessor worker seat, beacon_processor.rs)
+                node.network.processor.run_until_idle()
             head = node.chain.head_state
             print(f"slot {node.chain.current_slot} head {head.slot} "
                   f"finalized {node.chain.finalized_checkpoint[0]}")
@@ -272,8 +292,30 @@ def main(argv=None) -> int:
                     help="SSZ file: finalized BeaconState anchor")
     bn.add_argument("--checkpoint-block", default=None,
                     help="SSZ file: finalized SignedBeaconBlock anchor")
+    bn.add_argument("--listen-port", type=int, default=None,
+                    help="TCP wire listener port (0 = ephemeral)")
+    bn.add_argument("--bootnode", default=None,
+                    help="host:port of a bootnode registry to join")
+    bn.add_argument("--peer-id", default=None)
     bn.add_argument("--dry-run", action="store_true")
     bn.set_defaults(fn=cmd_bn)
+
+    boot = sub.add_parser("boot-node", help="run a discovery bootnode")
+    boot.add_argument("--port", type=int, default=0)
+
+    def cmd_boot(args):
+        from .network import Bootnode
+
+        b = Bootnode(port=args.port).start()
+        print(f"bootnode on {b.host}:{b.port}")
+        try:
+            while True:
+                time.sleep(60)
+        except KeyboardInterrupt:
+            b.stop()
+        return 0
+
+    boot.set_defaults(fn=cmd_boot)
 
     vc = sub.add_parser("vc", help="run a validator client")
     _add_network_args(vc)

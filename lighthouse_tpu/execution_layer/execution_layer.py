@@ -101,6 +101,32 @@ class ExecutionLayer:
 
     # -- production path -----------------------------------------------------
 
+    def build_payload_for_block(self, state, slot: int, proposer: int, preset, spec):
+        """Execution payload for a block being produced on `state` at
+        `slot` (the shared produce path of harness and BN block
+        production): parent selection across the merge transition,
+        spec-derived timestamp/randao, and the proposer's prepared fee
+        recipient."""
+        from ..state_transition.per_block import (
+            compute_timestamp_at_slot,
+            is_merge_transition_complete,
+        )
+        from ..types.helpers import get_randao_mix
+        from ..types import compute_epoch_at_slot
+
+        if is_merge_transition_complete(state):
+            parent_hash = bytes(state.latest_execution_payload_header.block_hash)
+        else:
+            # mock merge transition: build on the EL's genesis block
+            parent_hash = self.engine.genesis_hash
+        epoch = compute_epoch_at_slot(slot, preset)
+        return self.get_payload(
+            parent_hash,
+            compute_timestamp_at_slot(state, slot, spec),
+            bytes(get_randao_mix(state, epoch, preset)),
+            fee_recipient=self.fee_recipient_for(proposer),
+        )
+
     def get_payload(
         self,
         parent_hash: bytes,

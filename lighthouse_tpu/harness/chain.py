@@ -234,25 +234,8 @@ class StateHarness:
             hasattr(body, "execution_payload")
             and self.execution_layer is not None
         ):
-            from ..state_transition.per_block import (
-                compute_timestamp_at_slot,
-                is_merge_transition_complete,
-            )
-            from ..types.helpers import get_randao_mix
-
-            if is_merge_transition_complete(state):
-                parent_hash = bytes(
-                    state.latest_execution_payload_header.block_hash
-                )
-            else:
-                # mock merge transition: build on the EL's genesis block
-                parent_hash = self.execution_layer.engine.genesis_hash
-            epoch = compute_epoch_at_slot(slot, self.preset)
-            body.execution_payload = self.execution_layer.get_payload(
-                parent_hash,
-                compute_timestamp_at_slot(state, slot, self.spec),
-                bytes(get_randao_mix(state, epoch, self.preset)),
-                fee_recipient=self.execution_layer.fee_recipient_for(proposer),
+            body.execution_payload = self.execution_layer.build_payload_for_block(
+                state, slot, proposer, self.preset, self.spec
             )
 
         block = block_cls(

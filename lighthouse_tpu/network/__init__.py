@@ -1,8 +1,9 @@
 """Networking (reference beacon_node/lighthouse_network +
 beacon_node/network, SURVEY.md section 2.3): gossip topics, req/resp
-protocols, router, sync, peer scoring -- over an in-process message bus
-(the simulator-style multi-node transport; a wire transport slots in
-behind the same API)."""
+protocols, router, sync, peer scoring -- over either transport: the
+in-process message bus (simulator-style multi-node) or the TCP wire
+stack (wire.py: ssz_snappy framing, bootnode discovery, flood gossip
+with seen-cache relay) behind the same API."""
 
 from .message_bus import GossipMessage, MessageBus, topic_name  # noqa: F401
 from .node import (  # noqa: F401
@@ -12,3 +13,5 @@ from .node import (  # noqa: F401
     NetworkNode,
 )
 from .simulator import Simulator  # noqa: F401
+from .sync import SyncManager  # noqa: F401
+from .wire import Bootnode, WireBus, WireCodec  # noqa: F401

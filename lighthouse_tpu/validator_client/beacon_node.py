@@ -176,24 +176,8 @@ class InProcessBeaconNode:
         if hasattr(body, "execution_payload") and el is not None:
             # payload build honors the proposer's prepared fee recipient
             # (preparation_service.rs -> execution_layer get_payload)
-            from ..state_transition.per_block import (
-                compute_timestamp_at_slot,
-                is_merge_transition_complete,
-            )
-            from ..types.helpers import get_randao_mix
-
-            if is_merge_transition_complete(state):
-                parent_hash = bytes(
-                    state.latest_execution_payload_header.block_hash
-                )
-            else:
-                parent_hash = el.engine.genesis_hash
-            epoch = compute_epoch_at_slot(slot, self.preset)
-            body.execution_payload = el.get_payload(
-                parent_hash,
-                compute_timestamp_at_slot(state, slot, self.spec),
-                bytes(get_randao_mix(state, epoch, self.preset)),
-                fee_recipient=el.fee_recipient_for(proposer),
+            body.execution_payload = el.build_payload_for_block(
+                state, slot, proposer, self.preset, self.spec
             )
 
         block = block_cls(

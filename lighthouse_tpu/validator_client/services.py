@@ -165,10 +165,20 @@ class ValidatorClient:
             preps.append({"validator_index": idx, "fee_recipient": fee})
         if not preps:
             return
-        node = self.nodes.best()
-        if hasattr(node, "prepare_proposers"):
-            node.prepare_proposers(preps)
+        # push to EVERY healthy BN, not just the current best: a mid-epoch
+        # failover target must already hold the recipients
+        pushed = False
+        for node in self.nodes.candidates:
+            if node.is_healthy() and hasattr(node, "prepare_proposers"):
+                node.prepare_proposers(preps)
+                pushed = True
+        if pushed:
             self._prepared_epochs.add(epoch)
+            # bounded: re-pushing an old epoch is harmless, so keep a
+            # short memory rather than growing forever
+            self._prepared_epochs = {
+                e for e in self._prepared_epochs if e + 2 >= epoch
+            }
 
     def _block_duty(self, slot: int) -> None:
         proposer = self.duties.block_proposal_duty(slot, self.preset)

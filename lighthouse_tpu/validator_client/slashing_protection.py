@@ -44,7 +44,7 @@ class SlashingDatabase:
         # calls in from handler threads (the reference serializes through
         # rusqlite's pooled connections, slashing_database.rs)
         self.conn = sqlite3.connect(path, check_same_thread=False)
-        self._lock = threading.Lock()
+        self._lock = threading.RLock()
         self.conn.executescript(_SCHEMA)
         self.conn.commit()
 
@@ -70,8 +70,8 @@ class SlashingDatabase:
     def check_and_insert_block_proposal(
         self, pubkey_hex: str, slot: int, signing_root: bytes
     ) -> None:
-        vid = self._validator_id(pubkey_hex)
         with self._lock, self.conn:  # atomic check-and-insert
+            vid = self._validator_id(pubkey_hex)
             row = self.conn.execute(
                 "SELECT signing_root FROM signed_blocks "
                 "WHERE validator_id = ? AND slot = ?",
@@ -107,8 +107,8 @@ class SlashingDatabase:
     ) -> None:
         if source_epoch > target_epoch:
             raise NotSafe("attestation source after target")
-        vid = self._validator_id(pubkey_hex)
         with self._lock, self.conn:
+            vid = self._validator_id(pubkey_hex)
             # double vote: same target, different root
             row = self.conn.execute(
                 "SELECT signing_root FROM signed_attestations "
@@ -150,6 +150,10 @@ class SlashingDatabase:
     # -- EIP-3076 interchange (interchange.rs) ------------------------------
 
     def export_interchange(self, genesis_validators_root: bytes) -> dict:
+        with self._lock:
+            return self._export_in_lock(genesis_validators_root)
+
+    def _export_in_lock(self, genesis_validators_root: bytes) -> dict:
         data = []
         for vid, pubkey in self.conn.execute(
             "SELECT id, public_key FROM validators"

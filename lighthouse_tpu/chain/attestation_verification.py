@@ -16,6 +16,7 @@ from __future__ import annotations
 from dataclasses import dataclass
 
 from ..crypto.bls import verify_signature_sets
+from ..utils import metrics as M
 from ..state_transition.context import ConsensusContext
 from ..state_transition.signature_sets import (
     aggregate_and_proof_signature_set,
@@ -79,20 +80,10 @@ def _early_checks_unaggregated(chain, attestation):
     return bits.index(True)
 
 
-def batch_verify_unaggregated(
-    chain, attestations, observed_attesters, ctxt: ConsensusContext | None = None
+def _setup_unaggregated_batch(
+    chain, attestations, observed_attesters, ctxt, state, get_pubkey,
+    survivors, rejected, batch_seen,
 ):
-    """[(attestation)] -> (verified: [VerifiedUnaggregated],
-    rejected: [(attestation, reason)]). ONE backend call for the batch
-    (beacon_chain.rs:1696 batch_verify_unaggregated_attestations_for_gossip).
-    """
-    ctxt = ctxt or ConsensusContext(chain.preset, chain.spec)
-    state = chain.head_state
-    get_pubkey = state_pubkey_getter(state)
-
-    survivors = []
-    rejected = []
-    batch_seen: set = set()
     for att in attestations:
         try:
             pos = _early_checks_unaggregated(chain, att)
@@ -122,10 +113,32 @@ def batch_verify_unaggregated(
         except (AttestationError, ValueError) as e:
             rejected.append((att, str(e)))
 
+
+def batch_verify_unaggregated(
+    chain, attestations, observed_attesters, ctxt: ConsensusContext | None = None
+):
+    """[(attestation)] -> (verified: [VerifiedUnaggregated],
+    rejected: [(attestation, reason)]). ONE backend call for the batch
+    (beacon_chain.rs:1696 batch_verify_unaggregated_attestations_for_gossip).
+    """
+    ctxt = ctxt or ConsensusContext(chain.preset, chain.spec)
+    state = chain.head_state
+    get_pubkey = state_pubkey_getter(state)
+
+    survivors = []
+    rejected = []
+    batch_seen: set = set()
+    with M.ATTN_BATCH_SETUP_TIMES.time():
+        _setup_unaggregated_batch(
+            chain, attestations, observed_attesters, ctxt, state,
+            get_pubkey, survivors, rejected, batch_seen,
+        )
     verified = []
     if survivors:
         sets = [s for _, s, _, _ in survivors]
-        if verify_signature_sets(sets):
+        with M.ATTN_BATCH_VERIFY_TIMES.time():
+            batch_ok = verify_signature_sets(sets)
+        if batch_ok:
             ok_items = survivors
         else:
             # fallback: re-verify per item (batch.rs:122-133)
@@ -138,6 +151,12 @@ def batch_verify_unaggregated(
         for att, _, indices, attester in ok_items:
             observed_attesters.observe(att.data.target.epoch, attester)
             verified.append(VerifiedUnaggregated(att, indices, attester))
+        M.ATTESTATIONS_PROCESSED.inc(len(verified))
+        if chain.validator_monitor is not None:
+            for v in verified:
+                chain.validator_monitor.on_gossip_attestation(
+                    v.indexed_indices, v.attestation.data.slot
+                )
     return verified, rejected
 
 

@@ -117,6 +117,8 @@ class BeaconChain:
         self.execution_layer = None
         # SSE event subscribers (events.rs): fn(kind: str, payload: dict)
         self.event_sinks: list = []
+        # optional per-validator observability (validator_monitor.rs)
+        self.validator_monitor = None
 
     def emit(self, kind: str, payload: dict) -> None:
         for sink in self.event_sinks:
@@ -202,22 +204,56 @@ class BeaconChain:
         self,
         signed_block,
         strategy: BlockSignatureStrategy = BlockSignatureStrategy.VERIFY_BULK,
+        pre_state=None,
     ) -> bytes:
         """Full import: signature batch -> transition -> store -> fork
-        choice -> head update. Returns the block root."""
+        choice -> head update. Returns the block root. Phases are timed
+        into the metrics registry (reference metrics.rs:37-80
+        BLOCK_PROCESSING_* family)."""
+        import time as _time
+
+        from ..utils import metrics as M
+
+        with M.BLOCK_PROCESSING_TIMES.time():
+            try:
+                block_root, fresh = self._process_block_timed(
+                    signed_block, strategy, pre_state
+                )
+            except BlockError:
+                M.BLOCKS_REJECTED.inc()
+                raise
+        if not fresh:
+            return block_root  # duplicate: no metrics, no monitor
+        M.BLOCKS_IMPORTED.inc()
+        if self.validator_monitor is not None:
+            self.validator_monitor.on_block_imported(
+                block_root, signed_block.message, _time.monotonic()
+            )
+        return block_root
+
+    def _process_block_timed(self, signed_block, strategy, pre_state=None):
+        from ..utils import metrics as M
+
         self.on_tick()
         block = signed_block.message
         block_root = block.tree_hash_root()
         if block_root in self._states:
-            return block_root  # duplicate import
+            return block_root, False  # duplicate import
 
-        parent_root = bytes(block.parent_root)
-        parent_state = self._states.get(parent_root)
-        if parent_state is None:
-            raise BlockError(f"unknown parent {parent_root.hex()[:12]}")
-
-        state = clone_state(parent_state)
-        state = process_slots(state, block.slot, self.preset, self.spec)
+        if pre_state is not None:
+            # gossip pipeline already cloned + slot-advanced the parent
+            # (block_verification.rs ExecutionPendingBlock state reuse)
+            state = pre_state
+        else:
+            parent_root = bytes(block.parent_root)
+            parent_state = self._states.get(parent_root)
+            if parent_state is None:
+                raise BlockError(f"unknown parent {parent_root.hex()[:12]}")
+            state = clone_state(parent_state)
+            with M.BLOCK_TRANSITION_TIMES.time():
+                state = process_slots(
+                    state, block.slot, self.preset, self.spec
+                )
         ctxt = ConsensusContext(self.preset, self.spec)
         if self.execution_layer is not None:
             # engine round trip runs INSIDE process_execution_payload (spec
@@ -230,14 +266,15 @@ class BeaconChain:
 
             ctxt.notify_new_payload = _notify
         try:
-            per_block_processing(
-                state,
-                signed_block,
-                self.preset,
-                self.spec,
-                strategy=strategy,
-                ctxt=ctxt,
-            )
+            with M.BLOCK_TRANSITION_TIMES.time():
+                per_block_processing(
+                    state,
+                    signed_block,
+                    self.preset,
+                    self.spec,
+                    strategy=strategy,
+                    ctxt=ctxt,
+                )
         except BlockProcessingError as e:
             raise BlockError(str(e)) from None
         except Exception as e:
@@ -261,7 +298,8 @@ class BeaconChain:
                 is PayloadVerificationStatus.VERIFIED
                 else "optimistic"
             )
-        state_root = cached_root(state)
+        with M.BLOCK_STATE_ROOT_TIMES.time():
+            state_root = cached_root(state)
         if bytes(block.state_root) != state_root:
             raise BlockError("block state_root mismatch")
 
@@ -276,6 +314,23 @@ class BeaconChain:
         )
         self._states[block_root] = state
 
+        with M.BLOCK_FORK_CHOICE_TIMES.time():
+            self._fork_choice_import(
+                signed_block, block_root, state, ctxt,
+                execution_status, execution_block_hash,
+            )
+        self.emit(
+            "block",
+            {"slot": block.slot, "block": "0x" + block_root.hex()},
+        )
+        self._prune_on_finality()
+        return block_root, True
+
+    def _fork_choice_import(
+        self, signed_block, block_root, state, ctxt,
+        execution_status, execution_block_hash,
+    ) -> None:
+        block = signed_block.message
         self.fork_choice.on_block(
             signed_block,
             block_root,
@@ -295,23 +350,26 @@ class BeaconChain:
                 list(indexed.attesting_indices),
                 bytes(att.data.beacon_block_root),
             )
+            if self.validator_monitor is not None:
+                self.validator_monitor.on_attestation_included(
+                    list(indexed.attesting_indices),
+                    att.data.slot,
+                    block.slot,
+                )
         old_head = self.head_root
         self.recompute_head()
-        self.emit(
-            "block",
-            {"slot": block.slot, "block": "0x" + block_root.hex()},
-        )
         if self.head_root != old_head:
+            head_state_root = self.store.get_chain_item(
+                b"block_post_state:" + self.head_root
+            )
             self.emit(
                 "head",
                 {
                     "slot": self.head_state.slot,
                     "block": "0x" + self.head_root.hex(),
-                    "state": "0x" + state_root.hex(),
+                    "state": "0x" + (head_state_root or b"").hex(),
                 },
             )
-        self._prune_on_finality()
-        return block_root
 
     # -- attestations (gossip path) -----------------------------------------
 

@@ -1,0 +1,126 @@
+"""Validator monitor (reference beacon_chain/src/validator_monitor.rs,
+1,690 LoC): per-registered-validator observability — block proposals,
+attestation inclusions and delays, missed duties — surfaced as metrics
+and queryable stats. Plus the block-times cache
+(block_times_cache.rs): per-block observed→imported latency."""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+
+from ..utils.metrics import REGISTRY
+
+
+@dataclass
+class MonitoredValidator:
+    index: int
+    blocks_proposed: int = 0
+    attestations_seen: int = 0
+    attestation_min_delay_slots: dict[int, int] = field(default_factory=dict)
+    last_attestation_slot: int | None = None
+
+
+@dataclass
+class BlockTimes:
+    slot: int
+    observed_at: float | None = None
+    imported_at: float | None = None
+
+    @property
+    def import_latency(self) -> float | None:
+        if self.observed_at is None or self.imported_at is None:
+            return None
+        return self.imported_at - self.observed_at
+
+
+class ValidatorMonitor:
+    """Registered-validator tracking fed by the chain's import paths
+    (beacon_chain calls in, exactly as the reference's monitor is driven
+    from block/attestation processing)."""
+
+    def __init__(self, auto_register: bool = False):
+        self.auto_register = auto_register
+        self.validators: dict[int, MonitoredValidator] = {}
+        self.block_times: dict[bytes, BlockTimes] = {}
+        self._proposals = REGISTRY.counter(
+            "validator_monitor_blocks_proposed_total",
+            "Blocks proposed by monitored validators",
+        )
+        self._attestations = REGISTRY.counter(
+            "validator_monitor_attestations_total",
+            "Attestations by monitored validators seen on-chain or gossip",
+        )
+        self._inclusion_delay = REGISTRY.histogram(
+            "validator_monitor_attestation_inclusion_delay_slots",
+            "Slots between attestation slot and block inclusion",
+            buckets=(1, 2, 3, 4, 8, 16, 32),
+        )
+
+    def register_validator(self, index: int) -> None:
+        self.validators.setdefault(index, MonitoredValidator(index))
+
+    def _get(self, index: int) -> MonitoredValidator | None:
+        v = self.validators.get(index)
+        if v is None and self.auto_register:
+            v = self.validators[index] = MonitoredValidator(index)
+        return v
+
+    # -- feed points (beacon_chain.rs import paths) -------------------------
+
+    def on_block_observed(self, block_root: bytes, slot: int, now: float) -> None:
+        bt = self.block_times.setdefault(bytes(block_root), BlockTimes(slot))
+        if bt.observed_at is None:
+            bt.observed_at = now
+
+    def on_block_imported(
+        self, block_root: bytes, block, now: float
+    ) -> None:
+        bt = self.block_times.setdefault(
+            bytes(block_root), BlockTimes(block.slot)
+        )
+        bt.imported_at = now
+        v = self._get(block.proposer_index)
+        if v is not None:
+            v.blocks_proposed += 1
+            self._proposals.inc()
+        # attestations included in this block credit their participants'
+        # inclusion delay (validator_monitor.rs register_attestation_in_block)
+
+    def on_attestation_included(
+        self, attester_indices, data_slot: int, block_slot: int
+    ) -> None:
+        delay = max(block_slot - data_slot, 1)
+        for idx in attester_indices:
+            v = self._get(idx)
+            if v is None:
+                continue
+            prior = v.attestation_min_delay_slots.get(data_slot)
+            if prior is None or delay < prior:
+                v.attestation_min_delay_slots[data_slot] = delay
+                self._inclusion_delay.observe(delay)
+
+    def on_gossip_attestation(self, attester_indices, slot: int) -> None:
+        for idx in attester_indices:
+            v = self._get(idx)
+            if v is not None:
+                v.attestations_seen += 1
+                v.last_attestation_slot = slot
+                self._attestations.inc()
+
+    # -- queries (the /lighthouse/ui/validator-metrics seat) ----------------
+
+    def stats(self, index: int) -> dict | None:
+        v = self.validators.get(index)
+        if v is None:
+            return None
+        delays = v.attestation_min_delay_slots.values()
+        return {
+            "index": v.index,
+            "blocks_proposed": v.blocks_proposed,
+            "attestations_seen": v.attestations_seen,
+            "attestations_included": len(v.attestation_min_delay_slots),
+            "mean_inclusion_delay": (
+                sum(delays) / len(delays) if delays else None
+            ),
+            "last_attestation_slot": v.last_attestation_slot,
+        }

@@ -190,8 +190,10 @@ class BeaconApiServer:
         self._thread: threading.Thread | None = None
 
     def metrics_text(self) -> str:
-        """Prometheus exposition (reference http_metrics/src/lib.rs:147 +
-        lighthouse_metrics globals)."""
+        """Prometheus exposition (reference http_metrics/src/lib.rs:147
+        gathering the lighthouse_metrics global registry)."""
+        from ..utils.metrics import REGISTRY
+
         chain = self.api.chain
         lines = [
             "# TYPE beacon_head_slot gauge",
@@ -201,7 +203,7 @@ class BeaconApiServer:
             "# TYPE beacon_validator_count gauge",
             f"beacon_validator_count {len(chain.head_state.validators)}",
         ]
-        return "\n".join(lines) + "\n"
+        return REGISTRY.expose() + "\n".join(lines) + "\n"
 
     def start(self) -> None:
         self._thread = threading.Thread(

@@ -154,8 +154,26 @@ class NetworkNode:
 
     def _work_block(self, item) -> None:
         signed_block, source = item
+        from ..chain.block_verification import (
+            BlockAlreadyKnown,
+            UnknownParent,
+            process_gossip_block,
+        )
+
         try:
-            self.chain.process_block(signed_block)
+            process_gossip_block(self.chain, signed_block)
+        except BlockAlreadyKnown:
+            return  # benign gossip/sync overlap: never penalized
+        except UnknownParent as e:
+            # chase the ANCESTRY we're missing (block_lookups/), then
+            # import the block we already hold -- no refetch of it
+            if self.sync_manager.lookup_block(e.parent_root):
+                try:
+                    process_gossip_block(self.chain, signed_block)
+                except BlockError:
+                    self.penalize(source)
+            else:
+                self.penalize(source, -1)
         except BlockError:
             self.penalize(source)
             return

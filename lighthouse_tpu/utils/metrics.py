@@ -1,0 +1,171 @@
+"""Prometheus-style metrics registry (reference common/lighthouse_metrics:
+global registry, start_timer/stop_timer section timers used as ad-hoc
+profilers throughout beacon_chain/src/metrics.rs:37-80).
+
+Counters, gauges, and histograms with a process-global default registry;
+`Histogram.time()` is the `start_timer` seat — block import is split into
+named phases exactly like the reference's BLOCK_PROCESSING_* family."""
+
+from __future__ import annotations
+
+import threading
+import time
+from contextlib import contextmanager
+
+_DEFAULT_BUCKETS = (
+    0.001, 0.0025, 0.005, 0.01, 0.025, 0.05, 0.1, 0.25, 0.5, 1.0, 2.5, 5.0,
+)
+
+
+class Counter:
+    def __init__(self, name: str, help_: str):
+        self.name = name
+        self.help = help_
+        self.value = 0.0
+        self._lock = threading.Lock()
+
+    def inc(self, amount: float = 1.0) -> None:
+        with self._lock:
+            self.value += amount
+
+    def expose(self) -> list[str]:
+        return [
+            f"# HELP {self.name} {self.help}",
+            f"# TYPE {self.name} counter",
+            f"{self.name} {self.value:g}",
+        ]
+
+
+class Gauge:
+    def __init__(self, name: str, help_: str):
+        self.name = name
+        self.help = help_
+        self.value = 0.0
+
+    def set(self, value: float) -> None:
+        self.value = value
+
+    def expose(self) -> list[str]:
+        return [
+            f"# HELP {self.name} {self.help}",
+            f"# TYPE {self.name} gauge",
+            f"{self.name} {self.value:g}",
+        ]
+
+
+class Histogram:
+    def __init__(self, name: str, help_: str, buckets=_DEFAULT_BUCKETS):
+        self.name = name
+        self.help = help_
+        self.buckets = tuple(buckets)
+        self.bucket_counts = [0] * (len(self.buckets) + 1)
+        self.sum = 0.0
+        self.count = 0
+        self._lock = threading.Lock()
+
+    def observe(self, value: float) -> None:
+        with self._lock:
+            self.sum += value
+            self.count += 1
+            for i, b in enumerate(self.buckets):
+                if value <= b:
+                    self.bucket_counts[i] += 1
+                    return
+            self.bucket_counts[-1] += 1
+
+    @contextmanager
+    def time(self):
+        """The start_timer/stop_timer seat (lighthouse_metrics)."""
+        t0 = time.perf_counter()
+        try:
+            yield
+        finally:
+            self.observe(time.perf_counter() - t0)
+
+    def expose(self) -> list[str]:
+        out = [
+            f"# HELP {self.name} {self.help}",
+            f"# TYPE {self.name} histogram",
+        ]
+        cum = 0
+        for b, c in zip(self.buckets, self.bucket_counts):
+            cum += c
+            out.append(f'{self.name}_bucket{{le="{b:g}"}} {cum}')
+        out.append(f'{self.name}_bucket{{le="+Inf"}} {self.count}')
+        out.append(f"{self.name}_sum {self.sum:g}")
+        out.append(f"{self.name}_count {self.count}")
+        return out
+
+
+class Registry:
+    def __init__(self):
+        self._metrics: dict[str, object] = {}
+        self._lock = threading.Lock()
+
+    def _get(self, cls, name, help_, **kw):
+        with self._lock:
+            m = self._metrics.get(name)
+            if m is None:
+                m = self._metrics[name] = cls(name, help_, **kw)
+            return m
+
+    def counter(self, name: str, help_: str = "") -> Counter:
+        return self._get(Counter, name, help_)
+
+    def gauge(self, name: str, help_: str = "") -> Gauge:
+        return self._get(Gauge, name, help_)
+
+    def histogram(self, name: str, help_: str = "", buckets=_DEFAULT_BUCKETS):
+        return self._get(Histogram, name, help_, buckets=buckets)
+
+    def expose(self) -> str:
+        with self._lock:
+            metrics = list(self._metrics.values())
+        lines: list[str] = []
+        for m in metrics:
+            lines.extend(m.expose())
+        return "\n".join(lines) + "\n"
+
+
+# the process-global registry (lighthouse_metrics' lazy_static globals)
+REGISTRY = Registry()
+
+# -- the beacon_chain metric family (metrics.rs:37-80) ------------------------
+
+BLOCK_PROCESSING_TIMES = REGISTRY.histogram(
+    "beacon_block_processing_seconds", "Full block import time"
+)
+BLOCK_SIGNATURE_TIMES = REGISTRY.histogram(
+    "beacon_block_processing_signature_seconds",
+    "Signature batch verification phase",
+)
+BLOCK_TRANSITION_TIMES = REGISTRY.histogram(
+    "beacon_block_processing_state_transition_seconds",
+    "per_block/per_slot state transition phase",
+)
+BLOCK_STATE_ROOT_TIMES = REGISTRY.histogram(
+    "beacon_block_processing_state_root_seconds", "State-root computation"
+)
+BLOCK_FORK_CHOICE_TIMES = REGISTRY.histogram(
+    "beacon_block_processing_fork_choice_seconds", "Fork-choice import + head"
+)
+ATTN_BATCH_SETUP_TIMES = REGISTRY.histogram(
+    "beacon_attestation_batch_setup_seconds",
+    "Gossip attestation batch: checks + set building",
+)
+ATTN_BATCH_VERIFY_TIMES = REGISTRY.histogram(
+    "beacon_attestation_batch_verify_seconds",
+    "Gossip attestation batch: backend signature verify",
+)
+BLOCKS_IMPORTED = REGISTRY.counter(
+    "beacon_blocks_imported_total", "Blocks successfully imported"
+)
+BLOCKS_REJECTED = REGISTRY.counter(
+    "beacon_blocks_rejected_total", "Blocks rejected on import"
+)
+# NOTE: head-slot / finalized-epoch are PER-CHAIN facts; they are exposed
+# by each node's /metrics endpoint from its own chain (server.py), not as
+# process globals -- multiple chains share one process in the simulator.
+ATTESTATIONS_PROCESSED = REGISTRY.counter(
+    "beacon_attestations_processed_total", "Gossip attestations verified"
+)

@@ -1,0 +1,112 @@
+"""Block verification typestate pipeline (chain/block_verification.py;
+coverage roles of reference beacon_chain/tests/block_verification.rs):
+gossip-stage rejections, proposer-signature gating, full-batch stage,
+segment batch verification for sync, unknown-parent signaling."""
+
+import pytest
+
+from lighthouse_tpu.chain.beacon_chain import BlockError
+from lighthouse_tpu.chain.block_verification import (
+    GossipVerifiedBlock,
+    SignatureVerifiedBlock,
+    UnknownParent,
+    process_gossip_block,
+    signature_verify_chain_segment,
+)
+from lighthouse_tpu.crypto.bls import set_backend
+from lighthouse_tpu.harness.beacon_chain_harness import BeaconChainHarness
+from lighthouse_tpu.types import ChainSpec, MINIMAL
+
+
+@pytest.fixture(autouse=True)
+def cpu_backend():
+    # real signatures: the pipeline's stages differ precisely in WHICH
+    # signatures they check, so fake crypto would mask the behavior
+    set_backend("cpu")
+    yield
+    set_backend("jax_tpu")
+
+
+def make_harness(n=8):
+    return BeaconChainHarness(n, MINIMAL, ChainSpec.interop(), sign=True)
+
+
+class TestGossipStage:
+    def test_valid_block_ascends_and_imports(self):
+        h = make_harness()
+        signed, _ = h.producer.produce_block(1)
+        h.chain.slot_clock.set_slot(1)
+        root = process_gossip_block(h.chain, signed)
+        assert h.chain.head_root == root
+
+    def test_future_block_rejected(self):
+        h = make_harness()
+        signed, _ = h.producer.produce_block(5)
+        h.chain.slot_clock.set_slot(1)
+        with pytest.raises(BlockError, match="future"):
+            GossipVerifiedBlock.verify(h.chain, signed)
+
+    def test_unknown_parent_signals_lookup(self):
+        h = make_harness()
+        s1, _ = h.producer.produce_block(1)
+        h.producer.apply_block(s1)  # producer advances; chain does NOT
+        s2, _ = h.producer.produce_block(2)
+        h.chain.slot_clock.set_slot(2)
+        with pytest.raises(UnknownParent) as e:
+            GossipVerifiedBlock.verify(h.chain, s2)
+        assert e.value.parent_root == bytes(s2.message.parent_root)
+
+    def test_bad_proposer_signature_rejected_at_gossip(self):
+        h = make_harness()
+        signed, _ = h.producer.produce_block(1)
+        signed.signature = b"\xaa" + bytes(signed.signature)[1:]
+        h.chain.slot_clock.set_slot(1)
+        with pytest.raises(BlockError, match="signature"):
+            GossipVerifiedBlock.verify(h.chain, signed)
+
+    def test_wrong_proposer_rejected_before_signature(self):
+        h = make_harness()
+        signed, _ = h.producer.produce_block(1)
+        signed.message.proposer_index = (
+            signed.message.proposer_index + 1
+        ) % 8
+        h.chain.slot_clock.set_slot(1)
+        with pytest.raises(BlockError, match="proposer"):
+            GossipVerifiedBlock.verify(h.chain, signed)
+
+
+class TestSegmentVerification:
+    def _segment(self, h, count):
+        blocks = []
+        for slot in range(1, count + 1):
+            signed, _ = h.producer.produce_block(slot)
+            h.producer.apply_block(signed)
+            blocks.append(signed)
+        return blocks
+
+    def test_segment_verifies_and_imports_in_one_batch(self):
+        h = make_harness()
+        blocks = self._segment(h, 3)
+        h.chain.slot_clock.set_slot(3)
+        verified = signature_verify_chain_segment(h.chain, blocks)
+        assert len(verified) == 3
+        for sv in verified:
+            sv.import_into(h.chain)
+        assert h.chain.head_root == blocks[-1].message.tree_hash_root()
+
+    def test_segment_rejects_tampered_middle_signature(self):
+        h = make_harness()
+        blocks = self._segment(h, 3)
+        blocks[1].signature = b"\xaa" + bytes(blocks[1].signature)[1:]
+        with pytest.raises(BlockError):
+            signature_verify_chain_segment(h.chain, blocks)
+
+    def test_segment_rejects_unlinked_blocks(self):
+        h = make_harness()
+        blocks = self._segment(h, 2)
+        other = make_harness()
+        foreign, _ = other.producer.produce_block(3)
+        with pytest.raises(BlockError, match="hash-chain|unknown parent"):
+            signature_verify_chain_segment(
+                h.chain, [blocks[0], foreign]
+            )

@@ -1,0 +1,144 @@
+"""Metrics registry + validator monitor (coverage roles of reference
+common/lighthouse_metrics tests and validator_monitor.rs behavior):
+per-phase block-import timers populate, counters track imports, the
+monitor records proposals/attestations/inclusion delays, and /metrics
+exposes the global registry."""
+
+import pytest
+
+from lighthouse_tpu.chain.validator_monitor import ValidatorMonitor
+from lighthouse_tpu.crypto.bls import set_backend
+from lighthouse_tpu.harness.beacon_chain_harness import BeaconChainHarness
+from lighthouse_tpu.types import ChainSpec, MINIMAL
+from lighthouse_tpu.utils.metrics import REGISTRY, Histogram, Registry
+
+SLOTS = MINIMAL.slots_per_epoch
+
+
+@pytest.fixture(autouse=True)
+def fake_crypto():
+    set_backend("fake")
+    yield
+    set_backend("jax_tpu")
+
+
+class TestRegistry:
+    def test_counter_gauge_histogram_exposition(self):
+        reg = Registry()
+        c = reg.counter("test_total", "a counter")
+        c.inc()
+        c.inc(2)
+        g = reg.gauge("test_gauge", "a gauge")
+        g.set(42)
+        h = reg.histogram("test_seconds", "a histogram", buckets=(0.1, 1.0))
+        h.observe(0.05)
+        h.observe(0.5)
+        h.observe(5.0)
+        text = reg.expose()
+        assert "test_total 3" in text
+        assert "test_gauge 42" in text
+        assert 'test_seconds_bucket{le="0.1"} 1' in text
+        assert 'test_seconds_bucket{le="1"} 2' in text
+        assert 'test_seconds_bucket{le="+Inf"} 3' in text
+        assert "test_seconds_count 3" in text
+
+    def test_timer_records(self):
+        h = Histogram("t_seconds", "", buckets=(10.0,))
+        with h.time():
+            pass
+        assert h.count == 1
+        assert h.sum < 1.0
+
+    def test_same_name_returns_same_metric(self):
+        reg = Registry()
+        assert reg.counter("x_total") is reg.counter("x_total")
+
+
+class TestChainMetricsAndMonitor:
+    def test_block_import_populates_phase_timers_and_monitor(self):
+        before = REGISTRY._metrics["beacon_block_processing_seconds"].count
+        h = BeaconChainHarness(16, MINIMAL, ChainSpec.interop())
+        monitor = ValidatorMonitor(auto_register=True)
+        h.chain.validator_monitor = monitor
+        h.extend_chain(SLOTS + 2)
+
+        m = REGISTRY._metrics
+        assert m["beacon_block_processing_seconds"].count - before >= SLOTS
+        assert m["beacon_block_processing_state_root_seconds"].count > 0
+        assert m["beacon_block_processing_fork_choice_seconds"].count > 0
+        assert m["beacon_blocks_imported_total"].value >= SLOTS
+
+        # every proposer in the chain was recorded, inclusion delays too
+        total_proposed = sum(
+            v.blocks_proposed for v in monitor.validators.values()
+        )
+        assert total_proposed == SLOTS + 2
+        included = [
+            v
+            for v in monitor.validators.values()
+            if v.attestation_min_delay_slots
+        ]
+        assert included, "no attestation inclusions recorded"
+        stats = monitor.stats(included[0].index)
+        assert stats["attestations_included"] >= 1
+        assert stats["mean_inclusion_delay"] >= 1
+
+    def test_block_times_cache_latency(self):
+        monitor = ValidatorMonitor()
+        root = b"\x01" * 32
+
+        class Blk:
+            slot = 5
+            proposer_index = 0
+
+        monitor.on_block_observed(root, 5, now=10.0)
+        monitor.on_block_imported(root, Blk(), now=10.25)
+        assert monitor.block_times[root].import_latency == 0.25
+
+    def test_metrics_endpoint_serves_registry(self):
+        from lighthouse_tpu.http_api import BeaconApi, BeaconApiServer
+        from lighthouse_tpu.validator_client import InProcessBeaconNode
+
+        h = BeaconChainHarness(16, MINIMAL, ChainSpec.interop())
+        h.extend_chain(2)
+        node = InProcessBeaconNode(h.chain)
+        server = BeaconApiServer(BeaconApi(node))
+        server.start()
+        try:
+            import urllib.request
+
+            with urllib.request.urlopen(
+                f"http://127.0.0.1:{server.port}/metrics"
+            ) as resp:
+                text = resp.read().decode()
+            assert "beacon_block_processing_seconds_count" in text
+            assert "beacon_blocks_imported_total" in text
+            assert "beacon_validator_count 16" in text
+        finally:
+            server.stop()
+
+
+class TestDuplicateImports:
+    def test_duplicate_import_not_double_counted(self):
+        from lighthouse_tpu.utils.metrics import REGISTRY as R
+
+        h = BeaconChainHarness(16, MINIMAL, ChainSpec.interop())
+        monitor = ValidatorMonitor(auto_register=True)
+        h.chain.validator_monitor = monitor
+        h.extend_chain(1)
+        head_block = h.chain.store.get_block_any_temperature(
+            h.chain.head_root
+        )
+        imported_before = R._metrics["beacon_blocks_imported_total"].value
+        proposed_before = sum(
+            v.blocks_proposed for v in monitor.validators.values()
+        )
+        h.chain.process_block(head_block)  # duplicate
+        assert (
+            R._metrics["beacon_blocks_imported_total"].value
+            == imported_before
+        )
+        assert (
+            sum(v.blocks_proposed for v in monitor.validators.values())
+            == proposed_before
+        )

@@ -169,7 +169,9 @@ class BeaconChain:
         state_root = store.get_chain_item(b"head_state_root")
         if head_root is None or state_root is None:
             raise BlockError("store holds no persisted chain")
-        state = store.get_full_state(state_root)
+        # get_state replays from the nearest stored snapshot when the head
+        # landed between snapshot slots (summary-only entry)
+        state = store.get_state(state_root)
         if state is None:
             raise BlockError("persisted head state missing")
         # snapshot the persisted anchor BEFORE __init__ overwrites it with

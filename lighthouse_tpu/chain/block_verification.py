@@ -83,6 +83,8 @@ class GossipVerifiedBlock:
             raise BlockError(
                 f"wrong proposer {block.proposer_index}, expected {expected}"
             )
+        from ..utils import metrics as M
+
         try:
             sig_set = block_proposal_signature_set(
                 state,
@@ -91,7 +93,8 @@ class GossipVerifiedBlock:
                 chain.preset,
                 chain.spec,
             )
-            ok = verify_signature_sets([sig_set])
+            with M.BLOCK_SIGNATURE_TIMES.time():
+                ok = verify_signature_sets([sig_set])
         except ValueError:  # undecodable signature/pubkey bytes
             ok = False
         if not ok:
@@ -113,13 +116,16 @@ class SignatureVerifiedBlock:
     ) -> "SignatureVerifiedBlock":
         """block_verification.rs:597: every signature EXCEPT the proposal
         (already checked) in one batch."""
+        from ..utils import metrics as M
+
         state = gossip_verified.pre_state
         verifier = BlockSignatureVerifier(state, chain.preset, chain.spec)
         try:
             verifier.include_all_signatures_except_block_proposal(
                 gossip_verified.signed_block
             )
-            ok = verifier.verify()
+            with M.BLOCK_SIGNATURE_TIMES.time():
+                ok = verifier.verify()
         except ValueError:  # undecodable signature/pubkey bytes
             ok = False
         if not ok:
@@ -183,7 +189,9 @@ def signature_verify_chain_segment(chain: BeaconChain, blocks) -> list:
         except ValueError:
             raise BlockError("undecodable signature in segment") from None
         prev_root = block.tree_hash_root()
-        out.append(SignatureVerifiedBlock(signed, prev_root))
+        # snapshot the advanced pre-state so import skips its own clone +
+        # process_slots (same reuse as the gossip pipeline's pre_state)
+        out.append(SignatureVerifiedBlock(signed, prev_root, clone_state(state)))
         # apply the block so the NEXT block's committees/proposer derive
         # from the right state (NO_VERIFICATION: sets already collected)
         from ..state_transition import per_block_processing
@@ -199,6 +207,10 @@ def signature_verify_chain_segment(chain: BeaconChain, blocks) -> list:
             )
         except BlockProcessingError as e:
             raise BlockError(str(e)) from None
-    if not verifier.verify():
+    from ..utils import metrics as M
+
+    with M.BLOCK_SIGNATURE_TIMES.time():
+        batch_ok = verifier.verify()
+    if not batch_ok:
         raise BlockError("segment signature batch failed")
     return out

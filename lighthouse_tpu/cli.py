@@ -86,11 +86,20 @@ def build_beacon_node(args):
     store -> genesis -> chain -> pools -> API server."""
     from .http_api import BeaconApi, BeaconApiServer
     from .store.hot_cold import HotColdDB
-    from .store.kv import FileStore, MemoryStore
+    from .store.kv import MemoryStore
     from .validator_client.beacon_node import InProcessBeaconNode
 
     preset, spec = _spec_preset(args)
-    kv = FileStore(args.datadir) if args.datadir else MemoryStore()
+    if args.datadir:
+        # embedded C++ log-structured store (the LevelDB seat)
+        import os
+
+        from .store.native_kv import NativeStore
+
+        os.makedirs(args.datadir, exist_ok=True)
+        kv = NativeStore(os.path.join(args.datadir, "chain.db"))
+    else:
+        kv = MemoryStore()
     store = HotColdDB(kv, preset, spec)
     chain = resolve_genesis(args, store, preset, spec)
     node = InProcessBeaconNode(chain)
@@ -222,13 +231,27 @@ def cmd_am(args):
 
 
 def cmd_db(args):
+    import os
+
     from .store.kv import Column, FileStore
 
-    kv = FileStore(args.datadir)
+    native_path = os.path.join(args.datadir, "chain.db")
+    if os.path.isfile(native_path):
+        from .store.native_kv import NativeStore
+
+        kv = NativeStore(native_path)
+    else:
+        kv = FileStore(args.datadir)
     if args.db_cmd == "inspect":
         for name in ("BLOCK", "STATE", "STATE_SUMMARY", "FREEZER_BLOCK"):
             col = getattr(Column, name)
             print(f"{name.lower()}: {len(kv.keys(col))} entries")
+    elif args.db_cmd == "compact":
+        if not hasattr(kv, "compact"):
+            print("compact: not supported for this datadir format")
+            return 1
+        kv.compact()
+        print("compacted")
     elif args.db_cmd == "version":
         print("schema version 1")
     return 0
@@ -342,7 +365,7 @@ def main(argv=None) -> int:
     am.set_defaults(fn=cmd_am)
 
     db = sub.add_parser("db", help="database manager")
-    db.add_argument("db_cmd", choices=["inspect", "version"])
+    db.add_argument("db_cmd", choices=["inspect", "compact", "version"])
     db.add_argument("--datadir", required=True)
     db.set_defaults(fn=cmd_db)
 

@@ -89,17 +89,34 @@ def fp2_sqrt(a):
     so wrong candidates can never report is_square. The four Fp sqrt
     candidates (d1, d2, c0, -c0) share ONE exponentiation scan on a
     stacked axis.
-    """
+
+    Inversion-free x1: with w = d^((p-3)/4), the candidate is
+    x0 = w * d = d^((p+1)/4), and on the selected branch d is a verified
+    QR, so w^2 * d = d^((p-1)/2) = 1, i.e. 1/d = w^2 and
+    1/x0 = x0 / d = w^2 * x0 — no Fermat inversion scan. (If neither
+    branch is a QR, cand is garbage and the final resquare check reports
+    not-a-square, exactly as before.)"""
     c0, c1 = a[..., 0, :], a[..., 1, :]
     norm = L.add(L.sq(c0), L.sq(c1))
     alpha = _fp_sqrt_cand(norm)
     d1 = L.mul(L.add(c0, alpha), _INV2)
     d2 = L.mul(L.sub(c0, alpha), _INV2)
-    cands = _fp_sqrt_cand(jnp.stack([d1, d2, c0, L.neg(c0)], axis=0))
-    x0a, x0b, s_pos, s_neg = cands[0], cands[1], cands[2], cands[3]
+    # w = d^((p-3)/4) for d1, d2 (ONE stacked scan with the plain
+    # candidates for c0 / -c0, whose exponent differs: they use
+    # (p+1)/4 = (p-3)/4 + 1, i.e. one extra mul by the base)
+    ws = T.fp_pow_static(
+        jnp.stack([d1, d2, c0, L.neg(c0)], axis=0), (P - 3) // 4
+    )
+    w1, w2 = ws[0], ws[1]
+    x0a = L.mul(w1, d1)  # d1^((p+1)/4)
+    x0b = L.mul(w2, d2)
+    s_pos = L.mul(ws[2], c0)
+    s_neg = L.mul(ws[3], L.neg(c0))
     use_a = L.eq(L.sq(x0a), d1)
     x0 = L.select(use_a, x0a, x0b)
-    x1 = L.mul(L.mul(c1, _INV2), T.fp_inv(x0))
+    w = L.select(use_a, w1, w2)
+    inv_x0 = L.mul(L.sq(w), x0)  # = x0 / d, see docstring
+    x1 = L.mul(L.mul(c1, _INV2), inv_x0)
     cand = jnp.stack([x0, x1], axis=-2)
 
     # c1 == 0: root is (sqrt(c0), 0) or (0, sqrt(-c0)) since u^2 = -1
@@ -148,8 +165,15 @@ def map_to_curve_sswu(u):
     zu2 = T.fp2_mul(_Z, u2)
     tv1 = T.fp2_add(T.fp2_sq(zu2), zu2)
     tv1_zero = T.fp2_is_zero(tv1)
+    # ONE Fermat scan for the whole batch instead of per-element: zeros
+    # would poison the Montgomery prefix products, so they are masked to
+    # one first (their x1 is overridden by the tv1_zero select below)
+    tv1_safe = T.fp2_select(tv1_zero, T.fp2_one(tv1_zero.shape), tv1)
+    flat = tv1_safe.reshape((-1,) + tv1_safe.shape[-2:])
+    inv_flat = T.fp2_batch_inv(flat, axis=0)
+    tv1_inv = inv_flat.reshape(tv1_safe.shape)
     x1_main = T.fp2_mul(
-        _NEG_B_OVER_A_DEV, T.fp2_add(T.fp2_inv(tv1), T.fp2_one(tv1_zero.shape))
+        _NEG_B_OVER_A_DEV, T.fp2_add(tv1_inv, T.fp2_one(tv1_zero.shape))
     )
     x1 = T.fp2_select(
         tv1_zero, jnp.broadcast_to(_B_OVER_ZA_DEV, x1_main.shape), x1_main

@@ -30,7 +30,9 @@ class BeaconChainHarness:
         )
         self.preset = preset
         self.spec = self.producer.spec
-        self.store = HotColdDB(kv or MemoryStore(), preset, self.spec)
+        self.store = HotColdDB(
+            kv if kv is not None else MemoryStore(), preset, self.spec
+        )
         self.chain = BeaconChain(
             self.store, self.producer.state, preset, self.spec
         )

@@ -115,10 +115,7 @@ class SyncManager:
         if verified is not None:
             for sv in verified:
                 try:
-                    chain.process_block(
-                        sv.signed_block,
-                        strategy=BlockSignatureStrategy.NO_VERIFICATION,
-                    )
+                    sv.import_into(chain)  # reuses the advanced pre-state
                     imported += 1
                 except BlockError:
                     continue

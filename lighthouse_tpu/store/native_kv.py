@@ -1,0 +1,158 @@
+"""Native embedded KV backend (the LevelDB seat, reference
+beacon_node/store/src/leveldb_store.rs): a C++ log-structured store
+(native/kvstore.cc) behind the same KeyValueStore interface as
+MemoryStore/FileStore. ctypes binding (no pybind11 in the image); the
+shared library is built on demand with g++.
+
+Crash semantics match the reference's expectations of LevelDB:
+`do_atomically` frames the ops between batch begin/commit records, and
+replay drops uncommitted batches and torn tails."""
+
+from __future__ import annotations
+
+import ctypes
+import os
+import subprocess
+import threading
+
+from .kv import KeyValueStore
+
+_SRC = os.path.join(
+    os.path.dirname(os.path.dirname(os.path.dirname(os.path.abspath(__file__)))),
+    "native",
+    "kvstore.cc",
+)
+_LIB_PATH = os.path.join(os.path.dirname(_SRC), "libkvstore.so")
+_BUILD_LOCK = threading.Lock()
+_LIB = None
+
+
+def _build_lib() -> str:
+    with _BUILD_LOCK:
+        if os.path.exists(_LIB_PATH) and os.path.getmtime(
+            _LIB_PATH
+        ) >= os.path.getmtime(_SRC):
+            return _LIB_PATH
+        subprocess.run(
+            [
+                "g++", "-O2", "-shared", "-fPIC", "-std=c++17",
+                _SRC, "-o", _LIB_PATH,
+            ],
+            check=True,
+            capture_output=True,
+        )
+        return _LIB_PATH
+
+
+# key pointer MUST be c_void_p: c_char_p would NUL-truncate before
+# string_at reads the full length (keys are 32-byte roots full of NULs)
+_KEY_CB = ctypes.CFUNCTYPE(None, ctypes.c_void_p, ctypes.c_size_t, ctypes.c_void_p)
+
+
+def _load():
+    global _LIB
+    if _LIB is not None:
+        return _LIB
+    lib = ctypes.CDLL(_build_lib())
+    lib.kv_open.restype = ctypes.c_void_p
+    lib.kv_open.argtypes = [ctypes.c_char_p]
+    lib.kv_close.argtypes = [ctypes.c_void_p]
+    sz = ctypes.c_size_t
+    buf = ctypes.c_char_p
+    lib.kv_put.argtypes = [ctypes.c_void_p, buf, sz, buf, sz, buf, sz]
+    lib.kv_delete.argtypes = [ctypes.c_void_p, buf, sz, buf, sz]
+    lib.kv_get.restype = ctypes.c_long
+    lib.kv_get.argtypes = [
+        ctypes.c_void_p, buf, sz, buf, sz, ctypes.c_char_p, sz,
+    ]
+    lib.kv_batch_begin.argtypes = [ctypes.c_void_p]
+    lib.kv_batch_put.argtypes = [ctypes.c_void_p, buf, sz, buf, sz, buf, sz]
+    lib.kv_batch_delete.argtypes = [ctypes.c_void_p, buf, sz, buf, sz]
+    lib.kv_batch_commit.argtypes = [ctypes.c_void_p]
+    lib.kv_keys.argtypes = [ctypes.c_void_p, buf, sz, _KEY_CB, ctypes.c_void_p]
+    lib.kv_compact.restype = ctypes.c_int
+    lib.kv_compact.argtypes = [ctypes.c_void_p]
+    lib.kv_len.restype = ctypes.c_size_t
+    lib.kv_len.argtypes = [ctypes.c_void_p]
+    _LIB = lib
+    return lib
+
+
+class NativeStore(KeyValueStore):
+    """C++ log-structured store; one file per database."""
+
+    def __init__(self, path: str):
+        lib = _load()
+        os.makedirs(os.path.dirname(os.path.abspath(path)), exist_ok=True)
+        self._lib = lib
+        self._db = lib.kv_open(path.encode())
+        if not self._db:
+            raise OSError(f"kv_open failed for {path}")
+        self._lock = threading.Lock()
+
+    def close(self) -> None:
+        with self._lock:
+            if self._db:
+                self._lib.kv_close(self._db)
+                self._db = None
+
+    def get(self, column: bytes, key: bytes) -> bytes | None:
+        with self._lock:
+            n = self._lib.kv_get(
+                self._db, column, len(column), key, len(key), None, 0
+            )
+            if n < 0:
+                return None
+            out = ctypes.create_string_buffer(n)
+            self._lib.kv_get(
+                self._db, column, len(column), key, len(key), out, n
+            )
+            return out.raw
+
+    def put(self, column: bytes, key: bytes, value: bytes) -> None:
+        value = bytes(value)
+        with self._lock:
+            self._lib.kv_put(
+                self._db, column, len(column), key, len(key), value, len(value)
+            )
+
+    def delete(self, column: bytes, key: bytes) -> None:
+        with self._lock:
+            self._lib.kv_delete(self._db, column, len(column), key, len(key))
+
+    def keys(self, column: bytes):
+        out: list[bytes] = []
+
+        @_KEY_CB
+        def cb(ptr, n, _ctx):
+            out.append(ctypes.string_at(ptr, n))
+
+        with self._lock:
+            self._lib.kv_keys(self._db, column, len(column), cb, None)
+        return out
+
+    def do_atomically(self, ops) -> None:
+        """All-or-nothing batch: one commit record, one fsync."""
+        with self._lock:
+            self._lib.kv_batch_begin(self._db)
+            for op, column, key, value in ops:
+                if op == "put":
+                    value = bytes(value)
+                    self._lib.kv_batch_put(
+                        self._db, column, len(column), key, len(key),
+                        value, len(value),
+                    )
+                else:
+                    self._lib.kv_batch_delete(
+                        self._db, column, len(column), key, len(key)
+                    )
+            self._lib.kv_batch_commit(self._db)
+
+    def compact(self) -> None:
+        with self._lock:
+            if self._lib.kv_compact(self._db) != 0:
+                raise OSError("kv_compact failed")
+
+    def __len__(self) -> int:
+        with self._lock:
+            return self._lib.kv_len(self._db)

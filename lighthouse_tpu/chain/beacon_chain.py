@@ -242,15 +242,17 @@ class BeaconChain:
         if block_root in self._states:
             return block_root, False  # duplicate import
 
+        parent_root = bytes(block.parent_root)
+        if parent_root not in self._states:
+            # checked on BOTH paths: importing on a pre-state whose parent
+            # was never imported would register a detached fork-choice root
+            raise BlockError(f"unknown parent {parent_root.hex()[:12]}")
         if pre_state is not None:
             # gossip pipeline already cloned + slot-advanced the parent
             # (block_verification.rs ExecutionPendingBlock state reuse)
             state = pre_state
         else:
-            parent_root = bytes(block.parent_root)
-            parent_state = self._states.get(parent_root)
-            if parent_state is None:
-                raise BlockError(f"unknown parent {parent_root.hex()[:12]}")
+            parent_state = self._states[parent_root]
             state = clone_state(parent_state)
             with M.BLOCK_TRANSITION_TIMES.time():
                 state = process_slots(

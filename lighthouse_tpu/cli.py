@@ -34,6 +34,9 @@ def _add_network_args(p):
     p.add_argument("--preset", default="minimal",
                    choices=["minimal", "mainnet"])
     p.add_argument("--altair-fork-epoch", type=int, default=None)
+    p.add_argument("--log-level", default="info",
+                   choices=["trace", "debug", "info", "warn", "error"])
+    p.add_argument("--log-json", action="store_true")
 
 
 # --- beacon node ------------------------------------------------------------
@@ -91,13 +94,26 @@ def build_beacon_node(args):
 
     preset, spec = _spec_preset(args)
     if args.datadir:
-        # embedded C++ log-structured store (the LevelDB seat)
         import os
 
-        from .store.native_kv import NativeStore
+        native_path = os.path.join(args.datadir, "chain.db")
+        if os.path.isdir(args.datadir) and not os.path.exists(
+            native_path
+        ) and any(
+            os.path.isdir(os.path.join(args.datadir, d))
+            for d in ("chn", "blk", "ste")
+        ):
+            # legacy FileStore datadir: keep reading it rather than
+            # silently abandoning its chain under a fresh chain.db
+            from .store.kv import FileStore
 
-        os.makedirs(args.datadir, exist_ok=True)
-        kv = NativeStore(os.path.join(args.datadir, "chain.db"))
+            kv = FileStore(args.datadir)
+        else:
+            # embedded C++ log-structured store (the LevelDB seat)
+            from .store.native_kv import NativeStore
+
+            os.makedirs(args.datadir, exist_ok=True)
+            kv = NativeStore(native_path)
     else:
         kv = MemoryStore()
     store = HotColdDB(kv, preset, spec)
@@ -125,27 +141,52 @@ def build_beacon_node(args):
 
 
 def cmd_bn(args):
+    from .utils.executor import TaskExecutor
+    from .utils.logging import Logger
+
+    log = Logger(level=args.log_level, json_lines=args.log_json).child(
+        service="bn"
+    )
     node, server = build_beacon_node(args)
     server.start()
-    print(f"beacon node: http API on :{server.port}, "
-          f"{len(node.chain.head_state.validators)} validators")
+    log.info("beacon node started", http_port=server.port,
+             validators=len(node.chain.head_state.validators))
     if args.dry_run:
         server.stop()
         return 0
+
+    # service threads on the executor (environment + task_executor seat):
+    # the notifier and gossip drain run as tracked tasks; ctrl-c or a task
+    # failure broadcasts shutdown and everything joins
+    executor = TaskExecutor("bn")
+
+    def notifier():  # client/src/notifier.rs
+        head = node.chain.head_state
+        log.info("status", slot=node.chain.current_slot, head=head.slot,
+                 finalized=node.chain.finalized_checkpoint[0])
+
+    def tick():
+        node.chain.on_tick()
+        if hasattr(node, "network"):
+            # drain gossip work queued by the wire listener threads
+            # (the BeaconProcessor worker seat, beacon_processor.rs)
+            node.network.processor.run_until_idle()
+
+    executor.spawn_loop(tick, "per-slot", node.spec.seconds_per_slot)
+    executor.spawn_loop(notifier, "notifier", node.spec.seconds_per_slot)
+    rc = 0
     try:
-        while True:  # notifier loop (client/src/notifier.rs)
-            time.sleep(node.spec.seconds_per_slot)
-            node.chain.on_tick()
-            if hasattr(node, "network"):
-                # drain gossip work queued by the wire listener threads
-                # (the BeaconProcessor worker seat, beacon_processor.rs)
-                node.network.processor.run_until_idle()
-            head = node.chain.head_state
-            print(f"slot {node.chain.current_slot} head {head.slot} "
-                  f"finalized {node.chain.finalized_checkpoint[0]}")
+        executor.wait_shutdown()
+        reason = executor.shutdown_reason()
+        if reason is not None and reason.failure:
+            log.crit("shutting down on failure", reason=reason.message)
+            rc = 1  # supervisors must see the failure
     except KeyboardInterrupt:
-        server.stop()
-    return 0
+        executor.shutdown("ctrl-c")
+        log.info("shutting down")
+    server.stop()
+    executor.join_all()
+    return rc
 
 
 # --- validator client -------------------------------------------------------

@@ -118,7 +118,9 @@ class SyncManager:
                     sv.import_into(chain)  # reuses the advanced pre-state
                     imported += 1
                 except BlockError:
-                    continue
+                    # every later block descends from this one; continuing
+                    # with pre-states would install detached roots
+                    break
             return imported, imported > 0
         for blk in blocks:  # fallback: per-block full verification
             try:

@@ -150,7 +150,14 @@ class NativeStore(KeyValueStore):
 
     def compact(self) -> None:
         with self._lock:
-            if self._lib.kv_compact(self._db) != 0:
+            rc = self._lib.kv_compact(self._db)
+            if rc == -2:
+                # the log handle could not be reopened: nothing further
+                # can be persisted, fail loudly rather than corrupt
+                self._lib.kv_close(self._db)
+                self._db = None
+                raise OSError("kv_compact lost the log handle; store closed")
+            if rc != 0:
                 raise OSError("kv_compact failed")
 
     def __len__(self) -> int:

@@ -170,7 +170,14 @@ void replay(Db* db) {
 
 void append(Db* db, const std::vector<uint8_t>& buf, bool sync) {
   fwrite(buf.data(), 1, buf.size(), db->log);
-  if (sync) fflush(db->log);
+  if (sync) {
+    fflush(db->log);
+#ifndef _WIN32
+    // fflush only reaches the page cache; durability across machine
+    // crashes (the do_atomically contract) needs the disk barrier
+    fdatasync(fileno(db->log));
+#endif
+  }
 }
 
 }  // namespace
@@ -293,10 +300,10 @@ int kv_compact(void* h) {
   fclose(db->log);
   if (rename(tmp.c_str(), db->path.c_str()) != 0) {
     db->log = fopen(db->path.c_str(), "ab");
-    return -1;
+    return db->log ? -1 : -2;  // -2: log handle lost, db unusable
   }
   db->log = fopen(db->path.c_str(), "ab");
-  return 0;
+  return db->log ? 0 : -2;
 }
 
 size_t kv_len(void* h) {

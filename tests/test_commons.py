@@ -1,0 +1,87 @@
+"""Commons: TaskExecutor shutdown broadcast + structured logging
+(coverage roles of reference common/task_executor and logging tests)."""
+
+import io
+import json
+import time
+
+from lighthouse_tpu.utils.executor import TaskExecutor
+from lighthouse_tpu.utils.logging import Logger
+
+
+class TestTaskExecutor:
+    def test_spawn_and_join(self):
+        ex = TaskExecutor()
+        out = []
+        ex.spawn(lambda: out.append(1), "t1")
+        ex.spawn(lambda: out.append(2), "t2")
+        ex.shutdown("done")
+        ex.join_all()
+        assert sorted(out) == [1, 2]
+
+    def test_failure_triggers_shutdown_broadcast(self):
+        ex = TaskExecutor()
+
+        def boom():
+            raise RuntimeError("kaput")
+
+        ex.spawn(boom, "bad")
+        assert ex.wait_shutdown(timeout=5), "failure did not broadcast"
+        reason = ex.shutdown_reason()
+        assert reason.failure
+        assert "kaput" in reason.message
+
+    def test_spawn_loop_stops_on_shutdown(self):
+        ex = TaskExecutor()
+        ticks = []
+        ex.spawn_loop(lambda: ticks.append(1), "ticker", interval_s=0.01)
+        time.sleep(0.08)
+        ex.shutdown()
+        ex.join_all()
+        n = len(ticks)
+        assert n >= 2
+        time.sleep(0.05)
+        assert len(ticks) == n  # no ticks after shutdown
+
+    def test_spawn_after_shutdown_refused(self):
+        ex = TaskExecutor()
+        ex.shutdown()
+        import pytest
+
+        with pytest.raises(RuntimeError):
+            ex.spawn(lambda: None, "late")
+
+
+class TestLogger:
+    def test_levels_and_kv(self):
+        buf = io.StringIO()
+        log = Logger(level="info", stream=buf)
+        log.debug("hidden")
+        log.info("visible", slot=7)
+        text = buf.getvalue()
+        assert "hidden" not in text
+        assert "visible" in text and "slot=7" in text
+
+    def test_child_context_binds(self):
+        buf = io.StringIO()
+        log = Logger(level="info", stream=buf)
+        svc = log.child(service="beacon")
+        svc.warn("head stalled", slot=9)
+        text = buf.getvalue()
+        assert "service=beacon" in text and "slot=9" in text
+
+    def test_json_lines(self):
+        buf = io.StringIO()
+        log = Logger(level="info", stream=buf, json_lines=True)
+        log.child(service="vc").error("oops", code=3)
+        rec = json.loads(buf.getvalue())
+        assert rec["level"] == "error"
+        assert rec["service"] == "vc"
+        assert rec["code"] == 3
+
+    def test_file_sink(self, tmp_path):
+        path = str(tmp_path / "node.log")
+        log = Logger(level="info", stream=io.StringIO(), path=path)
+        log.info("persisted")
+        with open(path) as f:
+            assert "persisted" in f.read()

@@ -206,52 +206,26 @@ def eq(p, q, F):
 # --- scalar multiplication --------------------------------------------------
 
 
-_LADDER_WINDOW = 4  # 4-bit windows: 4 doubles + ONE table add per digit
-
-
-def _point_table(p, F):
-    """[0]P .. [15]P stacked on a new axis 0 (14 sequential adds; the
-    complete law makes [0]P = infinity legal everywhere)."""
-    batch = p.shape[: p.ndim - F.coord_ndim - 1]
-    table = [infinity(F, batch), p]
-    for _ in range(2, 1 << _LADDER_WINDOW):
-        table.append(add(table[-1], p, F))
-    return jnp.stack(table, axis=0)
+# NOTE on windowed ladders: a 4-bit-window variant (precomputed 15-entry
+# point tables + one table add per digit) was measured at +11% verifier
+# throughput on the TPU, but its unrolled table construction and in-scan
+# table gathers blew the 8-device SPMD compile up ~2.5x (387s -> >870s on
+# the CPU mesh), busting the multichip-dryrun budget. Bit ladders stay
+# until the compile cost is solved (e.g. building tables inside a scan).
 
 
 def scalar_mul_static(p, e: int, F):
-    """[e]P for a compile-time e >= 0. Fixed 4-bit windows: per digit 4
-    complete doublings + ONE table add (vs the bit ladder's add EVERY
-    bit: ~32% fewer field multiplies on the 64-bit cofactor/subgroup
-    exponents that dominate hash-to-curve)."""
+    """[e]P for a compile-time e >= 0: lax.scan over the bits (MSB first)."""
     if e == 0:
         return infinity(F, p.shape[: p.ndim - F.coord_ndim - 1])
-    table = _point_table(p, F)
-    nbits = e.bit_length()
-    ndigits = -(-nbits // _LADDER_WINDOW)
-    digits = jnp.asarray(
-        np.array(
-            [
-                (e >> (_LADDER_WINDOW * (ndigits - 1 - i)))
-                & ((1 << _LADDER_WINDOW) - 1)
-                for i in range(ndigits)
-            ],
-            np.int32,
-        )
-    )
+    bits = jnp.asarray(np.array([int(b) for b in bin(e)[2:]], np.bool_))
 
-    def body(acc, digit):
-        for _ in range(_LADDER_WINDOW):
-            acc = double(acc, F)
-        step = jax.lax.dynamic_index_in_dim(
-            table, digit, axis=0, keepdims=False
-        )
-        return add(acc, step, F), None
+    def body(acc, bit):
+        acc = double(acc, F)
+        return point_select(bit, add(acc, p, F), acc, F), None
 
-    init = jax.lax.dynamic_index_in_dim(
-        table, digits[0], axis=0, keepdims=False
-    )
-    out, _ = jax.lax.scan(body, init, digits[1:])
+    init = infinity(F, p.shape[: p.ndim - F.coord_ndim - 1])
+    out, _ = jax.lax.scan(body, init, bits)
     return out
 
 
@@ -259,38 +233,24 @@ def scalar_mul_u64(p, scalars, F):
     """[s]P for runtime 64-bit scalars (the batch-verify random weights).
 
     scalars: (...,) uint64-valued array given as (..., 2) uint32 (hi, lo).
-    Windowed like scalar_mul_static, but the per-element digits differ,
-    so the table step is a per-element gather (take_along_axis over the
-    stacked table axis)."""
+    Runs a 64-iteration MSB-first double-and-add ladder under lax.scan.
+    """
     hi = scalars[..., 0]
     lo = scalars[..., 1]
     word = jnp.stack([hi, lo], axis=0)  # (2, ...)
-    w = _LADDER_WINDOW
 
-    def digit_at(k):  # k in [0, 16), MSB-first 4-bit digits
-        bitpos = 64 - w * (k + 1)  # low bit index of the digit
-        word_idx = 0 if bitpos >= 32 else 1
-        shift = jnp.uint32(bitpos % 32)
-        return (
-            (word[word_idx] >> shift) & jnp.uint32((1 << w) - 1)
-        ).astype(jnp.int32)
+    def bit_at(k):  # k in [0, 64), MSB first
+        w = word[k // 32]
+        return ((w >> jnp.uint32(31 - (k % 32))) & jnp.uint32(1)) != 0
 
-    digits = jnp.stack([digit_at(k) for k in range(64 // w)], axis=0)
-    table = _point_table(p, F)  # (16, ..., coords)
+    bits = jnp.stack([bit_at(k) for k in range(64)], axis=0)  # (64, ...)
 
-    def pick(digit):
-        # per-element table row: digit (...,) indexes axis 0 of table
-        idx = digit.reshape(digit.shape + (1,) * (table.ndim - 1 - digit.ndim))
-        idx = jnp.broadcast_to(idx, (1,) + table.shape[1:])
-        return jnp.take_along_axis(table, idx, axis=0)[0]
+    def body(acc, bit):
+        acc = double(acc, F)
+        return point_select(bit, add(acc, p, F), acc, F), None
 
-    def body(acc, digit):
-        for _ in range(w):
-            acc = double(acc, F)
-        return add(acc, pick(digit), F), None
-
-    init = pick(digits[0])
-    out, _ = jax.lax.scan(body, init, digits[1:])
+    init = infinity(F, p.shape[: p.ndim - F.coord_ndim - 1])
+    out, _ = jax.lax.scan(body, init, bits)
     return out
 
 

@@ -96,16 +96,23 @@ class NativeStore(KeyValueStore):
                 self._lib.kv_close(self._db)
                 self._db = None
 
+    def _handle(self):
+        """The live C handle; raises (instead of letting the C side
+        dereference NULL -> SIGSEGV) once the store is closed."""
+        if self._db is None:
+            raise OSError("store is closed")
+        return self._db
+
     def get(self, column: bytes, key: bytes) -> bytes | None:
         with self._lock:
             n = self._lib.kv_get(
-                self._db, column, len(column), key, len(key), None, 0
+                self._handle(), column, len(column), key, len(key), None, 0
             )
             if n < 0:
                 return None
             out = ctypes.create_string_buffer(n)
             self._lib.kv_get(
-                self._db, column, len(column), key, len(key), out, n
+                self._handle(), column, len(column), key, len(key), out, n
             )
             return out.raw
 
@@ -113,12 +120,12 @@ class NativeStore(KeyValueStore):
         value = bytes(value)
         with self._lock:
             self._lib.kv_put(
-                self._db, column, len(column), key, len(key), value, len(value)
+                self._handle(), column, len(column), key, len(key), value, len(value)
             )
 
     def delete(self, column: bytes, key: bytes) -> None:
         with self._lock:
-            self._lib.kv_delete(self._db, column, len(column), key, len(key))
+            self._lib.kv_delete(self._handle(), column, len(column), key, len(key))
 
     def keys(self, column: bytes):
         out: list[bytes] = []
@@ -128,33 +135,33 @@ class NativeStore(KeyValueStore):
             out.append(ctypes.string_at(ptr, n))
 
         with self._lock:
-            self._lib.kv_keys(self._db, column, len(column), cb, None)
+            self._lib.kv_keys(self._handle(), column, len(column), cb, None)
         return out
 
     def do_atomically(self, ops) -> None:
         """All-or-nothing batch: one commit record, one fsync."""
         with self._lock:
-            self._lib.kv_batch_begin(self._db)
+            self._lib.kv_batch_begin(self._handle())
             for op, column, key, value in ops:
                 if op == "put":
                     value = bytes(value)
                     self._lib.kv_batch_put(
-                        self._db, column, len(column), key, len(key),
+                        self._handle(), column, len(column), key, len(key),
                         value, len(value),
                     )
                 else:
                     self._lib.kv_batch_delete(
-                        self._db, column, len(column), key, len(key)
+                        self._handle(), column, len(column), key, len(key)
                     )
-            self._lib.kv_batch_commit(self._db)
+            self._lib.kv_batch_commit(self._handle())
 
     def compact(self) -> None:
         with self._lock:
-            rc = self._lib.kv_compact(self._db)
+            rc = self._lib.kv_compact(self._handle())
             if rc == -2:
                 # the log handle could not be reopened: nothing further
                 # can be persisted, fail loudly rather than corrupt
-                self._lib.kv_close(self._db)
+                self._lib.kv_close(self._handle())
                 self._db = None
                 raise OSError("kv_compact lost the log handle; store closed")
             if rc != 0:
@@ -162,4 +169,4 @@ class NativeStore(KeyValueStore):
 
     def __len__(self) -> int:
         with self._lock:
-            return self._lib.kv_len(self._db)
+            return self._lib.kv_len(self._handle())

@@ -20,20 +20,17 @@ class Logger:
         stream=None,
         path: str | None = None,
         json_lines: bool = False,
-        context: dict | None = None,
-        _shared=None,
     ):
         self.level = LEVELS[level]
-        self.context = dict(context or {})
-        if _shared is not None:
-            self._shared = _shared  # child loggers share sinks + lock
-        else:
-            self._shared = {
-                "stream": stream if stream is not None else sys.stderr,
-                "file": open(path, "a") if path else None,
-                "json": json_lines,
-                "lock": threading.Lock(),
-            }
+        self.context: dict = {}
+        # child() is the ONLY other construction path (via __new__), and
+        # it shares this sink dict + lock
+        self._shared = {
+            "stream": stream if stream is not None else sys.stderr,
+            "file": open(path, "a") if path else None,
+            "json": json_lines,
+            "lock": threading.Lock(),
+        }
 
     def child(self, **context) -> "Logger":
         """Bound-context child (slog o!): service loggers carry their

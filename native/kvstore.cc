@@ -168,16 +168,16 @@ void replay(Db* db) {
   }
 }
 
-void append(Db* db, const std::vector<uint8_t>& buf, bool sync) {
+// flush=true pushes to the page cache (process-crash safety, LevelDB's
+// default non-sync write); barrier=true adds fdatasync -- paid only by
+// batch commits so the block-import hot path isn't 3 disk barriers/block
+void append(Db* db, const std::vector<uint8_t>& buf, bool flush,
+            bool barrier = false) {
   fwrite(buf.data(), 1, buf.size(), db->log);
-  if (sync) {
-    fflush(db->log);
+  if (flush) fflush(db->log);
 #ifndef _WIN32
-    // fflush only reaches the page cache; durability across machine
-    // crashes (the do_atomically contract) needs the disk barrier
-    fdatasync(fileno(db->log));
+  if (barrier) fdatasync(fileno(db->log));
 #endif
-  }
 }
 
 }  // namespace
@@ -270,7 +270,7 @@ void kv_batch_commit(void* h) {
   Record r{OP_BATCH_COMMIT, "", "", ""};
   std::vector<uint8_t> buf;
   encode(r, &buf);
-  append(db, buf, true);
+  append(db, buf, true, /*barrier=*/true);
 }
 
 // iterate keys of a column: calls back with (key_ptr, key_len)
@@ -296,6 +296,12 @@ int kv_compact(void* h) {
     encode(r, &buf);
     fwrite(buf.data(), 1, buf.size(), out);
   }
+  fflush(out);
+#ifndef _WIN32
+  // the rename must never expose an unsynced replacement: power loss
+  // after rename would otherwise lose the WHOLE database
+  fdatasync(fileno(out));
+#endif
   fclose(out);
   fclose(db->log);
   if (rename(tmp.c_str(), db->path.c_str()) != 0) {

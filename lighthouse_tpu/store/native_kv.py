@@ -139,12 +139,24 @@ class NativeStore(KeyValueStore):
         return out
 
     def do_atomically(self, ops) -> None:
-        """All-or-nothing batch: one commit record, one fsync."""
+        """All-or-nothing batch: one commit record, one disk barrier.
+
+        Ops are validated/converted BEFORE the BATCH_BEGIN record is
+        written: a mid-batch exception would otherwise leave an
+        unterminated batch marker that replay treats as the start of an
+        uncommitted region, truncating every later write on reopen."""
+        converted = []
+        for op, column, key, value in ops:
+            if op == "put":
+                converted.append((op, bytes(column), bytes(key), bytes(value)))
+            elif op == "delete":
+                converted.append((op, bytes(column), bytes(key), None))
+            else:
+                raise ValueError(f"unknown batch op {op!r}")
         with self._lock:
             self._lib.kv_batch_begin(self._handle())
-            for op, column, key, value in ops:
+            for op, column, key, value in converted:
                 if op == "put":
-                    value = bytes(value)
                     self._lib.kv_batch_put(
                         self._handle(), column, len(column), key, len(key),
                         value, len(value),

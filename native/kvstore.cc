@@ -21,6 +21,7 @@
 #include <vector>
 
 #ifndef _WIN32
+#include <fcntl.h>
 #include <unistd.h>
 #endif
 
@@ -290,24 +291,43 @@ int kv_compact(void* h) {
   std::string tmp = db->path + ".compact";
   FILE* out = fopen(tmp.c_str(), "wb");
   if (!out) return -1;
+  bool write_ok = true;
   for (const auto& kv : db->index) {
     Record r{OP_PUT, kv.first.first, kv.first.second, kv.second};
     std::vector<uint8_t> buf;
     encode(r, &buf);
-    fwrite(buf.data(), 1, buf.size(), out);
+    if (fwrite(buf.data(), 1, buf.size(), out) != buf.size()) write_ok = false;
   }
-  fflush(out);
+  if (fflush(out) != 0) write_ok = false;
 #ifndef _WIN32
   // the rename must never expose an unsynced replacement: power loss
   // after rename would otherwise lose the WHOLE database
-  fdatasync(fileno(out));
+  if (fdatasync(fileno(out)) != 0) write_ok = false;
 #endif
+  if (ferror(out)) write_ok = false;
   fclose(out);
+  if (!write_ok) {
+    // disk full / IO error: keep the good live log, drop the torn copy
+    remove(tmp.c_str());
+    return -1;
+  }
   fclose(db->log);
   if (rename(tmp.c_str(), db->path.c_str()) != 0) {
     db->log = fopen(db->path.c_str(), "ab");
     return db->log ? -1 : -2;  // -2: log handle lost, db unusable
   }
+#ifndef _WIN32
+  // fsync the parent directory so the rename itself is durable; without
+  // it a post-compaction committed batch can vanish with the new inode
+  std::string dir = db->path;
+  size_t slash = dir.find_last_of('/');
+  dir = (slash == std::string::npos) ? std::string(".") : dir.substr(0, slash);
+  int dfd = open(dir.c_str(), O_RDONLY | O_DIRECTORY);
+  if (dfd >= 0) {
+    fsync(dfd);
+    close(dfd);
+  }
+#endif
   db->log = fopen(db->path.c_str(), "ab");
   return db->log ? 0 : -2;
 }

@@ -37,3 +37,14 @@ _arm_compilation_cache()
 
 def pytest_configure(config):
     config.addinivalue_line("markers", "slow: long-running scale benchmark")
+
+
+def pytest_collection_modifyitems(session, config, items):
+    """Run the multichip (8-device SPMD) tests FIRST. Loading/compiling the
+    large sharded executables late in a long pytest process segfaults
+    inside XLA:CPU's executable loader (reproducible at ~60% suite
+    progress; the identical tests pass standalone and when run first),
+    so the big-program tests get the fresh-process slot."""
+    front = [i for i in items if "test_multichip" in str(i.fspath)]
+    rest = [i for i in items if "test_multichip" not in str(i.fspath)]
+    items[:] = front + rest

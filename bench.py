@@ -1,64 +1,257 @@
 """North-star benchmark: batched BLS signature-set verification on TPU.
 
-Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
+ALWAYS prints exactly ONE JSON line {"metric", "value", "unit",
+"vs_baseline", ...} and exits 0 -- the orchestrator never lets a flaky
+backend, a compile timeout, or a kernel bug turn into a missing artifact.
 
 Metric: aggregate-attestation signature sets verified per second on one
-chip, measured on the target from BASELINE.md ("batch-verify 10k aggregate
+chip, against the BASELINE.md target ("batch-verify 10k aggregate
 attestation signatures in <200 ms on a single TPU v4 chip", i.e. 50k
 sets/s). vs_baseline = achieved_sets_per_s / 50_000.
+
+Structure (the parent process never imports jax):
+  1. PROBE: a subprocess checks backend init (`jax.devices()`), retried
+     with backoff for up to ~3 minutes -- the TPU tunnel is known to flap.
+  2. RUN: a subprocess runs the measured bench on the probed platform and
+     prints its own JSON (compile time and steady-state time separated).
+  3. FALLBACK: on any failure, re-run the child forced to CPU (smaller
+     batch -- CPU pairing math is slow) and record the TPU failure in an
+     "error" field. Even total failure emits value 0.0.
+
+CPU forcing is done via `jax.config.update("jax_platforms", "cpu")` in
+the child, NOT the JAX_PLATFORMS env var: the axon sitecustomize
+registers its backend at interpreter start and the env var is captured
+too early to override it (same rationale as tests/conftest.py).
 
 Methodology: one warm jitted call over a bucket of synthetic
 fast_aggregate_verify sets (distinct messages, multi-pubkey aggregates,
 pre-marshaled device inputs -- steady-state marshaling is index gathers
 from the device-resident pubkey table, so the kernel is the contract).
+Fixtures are generated once via the pure-Python oracle, disk-cached under
+.bench_fixtures/, and tiled to the requested batch size (tiling valid
+sets keeps the batch valid and the per-set device work identical).
 """
 
 from __future__ import annotations
 
 import json
 import os
+import subprocess
 import sys
 import time
 
+HERE = os.path.dirname(os.path.abspath(__file__))
+TARGET_SETS_PER_S = 10_000 / 0.200  # BASELINE.md north star
 
-def main() -> None:
+
+def _emit(payload: dict) -> None:
+    print(json.dumps(payload))
+    sys.stdout.flush()
+
+
+def _run_child(mode: str, env_extra: dict, timeout_s: float):
+    """Run `bench.py --<mode>` in a subprocess; return (ok, json|None, err)."""
+    env = dict(os.environ)
+    env.update(env_extra)
+    try:
+        proc = subprocess.run(
+            [sys.executable, os.path.abspath(__file__), f"--{mode}"],
+            capture_output=True,
+            text=True,
+            timeout=timeout_s,
+            env=env,
+            cwd=HERE,
+        )
+    except subprocess.TimeoutExpired:
+        return False, None, f"{mode} timed out after {int(timeout_s)}s"
+    if proc.returncode != 0:
+        tail = (proc.stderr or proc.stdout or "").strip().splitlines()[-3:]
+        return False, None, f"{mode} rc={proc.returncode}: {' | '.join(tail)}"
+    for line in reversed((proc.stdout or "").strip().splitlines()):
+        try:
+            obj = json.loads(line)
+        except json.JSONDecodeError:
+            continue
+        if isinstance(obj, dict):
+            return True, obj, None
+    return False, None, f"{mode} produced no JSON"
+
+
+def orchestrate() -> None:
+    budget = float(os.environ.get("BENCH_BUDGET_S", "520"))
+    t_start = time.monotonic()
+
+    def remaining() -> float:
+        return budget - (time.monotonic() - t_start)
+
+    errors = []
+
+    # Phase 1: probe backend init with retry/backoff (the tunnel flaps).
+    platform = None
+    probe_timeout = 75.0
+    probe_deadline = min(170.0, budget * 0.40)
+    attempt = 0
+    while remaining() > 30.0:
+        elapsed = time.monotonic() - t_start
+        # always probe at least once; retries must fit the probe window
+        if attempt > 0 and elapsed + probe_timeout > probe_deadline:
+            break
+        attempt += 1
+        ok, info, err = _run_child(
+            "probe",
+            {},
+            timeout_s=min(probe_timeout, max(20.0, remaining() - 20.0)),
+        )
+        if ok and info and info.get("platform"):
+            platform = info["platform"]
+            break
+        errors.append(f"probe#{attempt}: {err}")
+        time.sleep(10.0)
+
+    # Phase 2: measured run on the probed platform.
+    result = None
+    if platform and platform != "cpu":
+        ok, result, err = _run_child(
+            "child",
+            {},
+            timeout_s=min(
+                max(120.0, remaining() - 170.0), max(30.0, remaining() - 5.0)
+            ),
+        )
+        if not ok:
+            errors.append(f"tpu-run: {err}")
+            result = None
+    elif platform == "cpu":
+        # Ambient platform is already CPU: run it directly as the primary
+        # measurement, not as a fallback.
+        ok, result, err = _run_child(
+            "child",
+            {"BENCH_SETS": os.environ.get("BENCH_SETS_CPU", os.environ.get("BENCH_SETS", "64"))},
+            timeout_s=max(30.0, remaining() - 5.0),
+        )
+        if not ok:
+            errors.append(f"cpu-run: {err}")
+            result = None
+
+    # Phase 3: CPU fallback if the TPU path yielded nothing.
+    if result is None and platform != "cpu":
+        ok, result, err = _run_child(
+            "child",
+            {
+                "BENCH_PLATFORM": "cpu",
+                # 16 sets: a shape kept warm in .jax_cache/cpu so the
+                # fallback is load+run, not a 6-minute XLA compile
+                "BENCH_SETS": os.environ.get("BENCH_SETS_CPU", "16"),
+                "BENCH_REPS": os.environ.get("BENCH_REPS_CPU", "2"),
+            },
+            timeout_s=max(30.0, remaining() - 5.0),
+        )
+        if not ok:
+            errors.append(f"cpu-fallback: {err}")
+            result = None
+
+    if result is None:
+        _emit(
+            {
+                "metric": "bls_signature_sets_verified_per_s_per_chip",
+                "value": 0.0,
+                "unit": "sets/s",
+                "vs_baseline": 0.0,
+                "platform": platform or "none",
+                "error": "; ".join(errors) or "unknown",
+            }
+        )
+        return
+
+    if errors:
+        result["error"] = "; ".join(errors)
+    _emit(result)
+
+
+def _force_platform() -> None:
+    """Apply BENCH_PLATFORM=cpu via the live config (env vars are captured
+    before the axon sitecustomize override and do not work)."""
+    if os.environ.get("BENCH_PLATFORM") == "cpu":
+        import jax
+
+        jax.config.update("jax_platforms", "cpu")
+
+
+def probe() -> None:
+    import jax
+
+    _force_platform()
+    devs = jax.devices()
+    _emit({"platform": devs[0].platform, "n_devices": len(devs)})
+
+
+def child() -> None:
     n_sets = int(os.environ.get("BENCH_SETS", "1024"))
     k_pk = int(os.environ.get("BENCH_PUBKEYS_PER_SET", "2"))
     reps = int(os.environ.get("BENCH_REPS", "3"))
+    distinct = int(os.environ.get("BENCH_DISTINCT", "32"))
 
-    sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+    sys.path.insert(0, HERE)
     import jax
 
+    _force_platform()
     from __graft_entry__ import _arm_compilation_cache, _example_batch
 
     _arm_compilation_cache()
     from lighthouse_tpu.crypto.bls.backends.jax_tpu import verify_jit
 
-    args = _example_batch(n_sets, k_pk)
-    kernel = verify_jit
+    t0 = time.perf_counter()
+    args = _example_batch(n_sets, k_pk, distinct=distinct)
+    fixture_s = time.perf_counter() - t0
 
-    ok = bool(jax.block_until_ready(kernel(*args)))  # compile + warm
+    t0 = time.perf_counter()
+    ok = bool(jax.block_until_ready(verify_jit(*args)))  # compile + warm
+    compile_s = time.perf_counter() - t0
     assert ok, "bench batch failed to verify"
 
     times = []
     for _ in range(reps):
         t0 = time.perf_counter()
-        jax.block_until_ready(kernel(*args))
+        jax.block_until_ready(verify_jit(*args))
         times.append(time.perf_counter() - t0)
     best = min(times)
     sets_per_s = n_sets / best
 
-    target = 10_000 / 0.200  # BASELINE.md north star: 10k sets / 200 ms
-    print(
-        json.dumps(
-            {
-                "metric": "bls_signature_sets_verified_per_s_per_chip",
-                "value": round(sets_per_s, 2),
-                "unit": "sets/s",
-                "vs_baseline": round(sets_per_s / target, 4),
-            }
-        )
+    _emit(
+        {
+            "metric": "bls_signature_sets_verified_per_s_per_chip",
+            "value": round(sets_per_s, 2),
+            "unit": "sets/s",
+            "vs_baseline": round(sets_per_s / TARGET_SETS_PER_S, 4),
+            "platform": jax.devices()[0].platform,
+            "n_sets": n_sets,
+            "pubkeys_per_set": k_pk,
+            "fixture_s": round(fixture_s, 2),
+            "compile_s": round(compile_s, 2),
+            "steady_s": round(best, 4),
+        }
     )
+
+
+def main() -> None:
+    if "--probe" in sys.argv:
+        probe()
+    elif "--child" in sys.argv:
+        child()
+    else:
+        try:
+            orchestrate()
+        except BaseException as exc:  # never lose the artifact
+            _emit(
+                {
+                    "metric": "bls_signature_sets_verified_per_s_per_chip",
+                    "value": 0.0,
+                    "unit": "sets/s",
+                    "vs_baseline": 0.0,
+                    "platform": "none",
+                    "error": f"orchestrator: {type(exc).__name__}: {exc}",
+                }
+            )
 
 
 if __name__ == "__main__":

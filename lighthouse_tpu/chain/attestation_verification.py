@@ -22,7 +22,6 @@ from ..state_transition.signature_sets import (
     aggregate_and_proof_signature_set,
     indexed_attestation_signature_set,
     selection_proof_signature_set,
-    state_pubkey_getter,
 )
 from ..types import compute_epoch_at_slot
 from ..types.helpers import hash32
@@ -123,7 +122,7 @@ def batch_verify_unaggregated(
     """
     ctxt = ctxt or ConsensusContext(chain.preset, chain.spec)
     state = chain.head_state
-    get_pubkey = state_pubkey_getter(state)
+    get_pubkey = chain.pubkey_cache.getter(state)
 
     survivors = []
     rejected = []
@@ -209,7 +208,7 @@ def batch_verify_aggregates(
     batch.rs:77-107), one backend call, per-item fallback."""
     ctxt = ctxt or ConsensusContext(chain.preset, chain.spec)
     state = chain.head_state
-    get_pubkey = state_pubkey_getter(state)
+    get_pubkey = chain.pubkey_cache.getter(state)
 
     survivors = []
     rejected = []

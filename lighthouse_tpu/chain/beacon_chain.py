@@ -112,6 +112,12 @@ class BeaconChain:
             b"oldest_block_meta",
             genesis_state.slot.to_bytes(8, "little") + self.oldest_block_parent,
         )
+        # decompressed-pubkey cache + device-resident limb table
+        # (validator_pubkey_cache.rs): decompress once at startup, append on
+        # deposit processing; verification paths resolve keys through it
+        from .pubkey_cache import ValidatorPubkeyCache
+
+        self.pubkey_cache = ValidatorPubkeyCache(genesis_state)
         # optional engine handle (reference beacon_chain.execution_layer);
         # None = pre-merge / no EL configured
         self.execution_layer = None
@@ -306,6 +312,10 @@ class BeaconChain:
             state_root = cached_root(state)
         if bytes(block.state_root) != state_root:
             raise BlockError("block state_root mismatch")
+
+        # deposits may have appended validators: decompress + upload the
+        # new keys now (import_new_pubkeys, validator_pubkey_cache.rs:79)
+        self.pubkey_cache.import_new_pubkeys(state)
 
         self.store.put_block(block_root, signed_block)
         # drop the incremental-hash cache before retaining: stored states

@@ -26,7 +26,6 @@ from ..state_transition import (
 from ..state_transition.per_slot import get_beacon_proposer_index
 from ..state_transition.signature_sets import (
     block_proposal_signature_set,
-    state_pubkey_getter,
 )
 from .beacon_chain import BeaconChain, BlockError
 
@@ -88,7 +87,7 @@ class GossipVerifiedBlock:
         try:
             sig_set = block_proposal_signature_set(
                 state,
-                state_pubkey_getter(state),
+                chain.pubkey_cache.getter(state),
                 signed_block,
                 chain.preset,
                 chain.spec,
@@ -119,7 +118,13 @@ class SignatureVerifiedBlock:
         from ..utils import metrics as M
 
         state = gossip_verified.pre_state
-        verifier = BlockSignatureVerifier(state, chain.preset, chain.spec)
+        verifier = BlockSignatureVerifier(
+            state,
+            chain.preset,
+            chain.spec,
+            get_pubkey=chain.pubkey_cache.getter(state),
+            resolve_pubkey=chain.pubkey_cache.resolve,
+        )
         try:
             verifier.include_all_signatures_except_block_proposal(
                 gossip_verified.signed_block
@@ -180,10 +185,16 @@ def signature_verify_chain_segment(chain: BeaconChain, blocks) -> list:
         if verifier is None:
             # one verifier accumulates every block's sets; committee
             # caches come from the advancing state
-            verifier = BlockSignatureVerifier(state, chain.preset, chain.spec)
+            verifier = BlockSignatureVerifier(
+                state,
+                chain.preset,
+                chain.spec,
+                get_pubkey=chain.pubkey_cache.getter(state),
+                resolve_pubkey=chain.pubkey_cache.resolve,
+            )
         else:
             verifier.state = state
-            verifier.get_pubkey = state_pubkey_getter(state)
+            verifier.get_pubkey = chain.pubkey_cache.getter(state)
         try:
             verifier.include_all_signatures(signed)
         except ValueError:

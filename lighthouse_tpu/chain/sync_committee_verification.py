@@ -19,7 +19,6 @@ from ..crypto.bls import AggregateSignature, Signature, verify_signature_sets
 from ..state_transition.context import ConsensusContext
 from ..state_transition.signature_sets import (
     contribution_and_proof_signature_set,
-    state_pubkey_getter,
     sync_committee_contribution_signature_set,
     sync_committee_message_set,
     sync_selection_proof_signature_set,
@@ -143,7 +142,7 @@ def batch_verify_sync_messages(
     """[(message, subnet_id)] -> (verified: [VerifiedSyncMessage],
     rejected: [(message, reason)]). ONE backend call for the batch."""
     state = chain.head_state
-    get_pubkey = state_pubkey_getter(state)
+    get_pubkey = chain.pubkey_cache.getter(state)
 
     survivors = []
     rejected = []
@@ -233,7 +232,7 @@ def batch_verify_contributions(
     all verified in ONE backend call."""
     state = chain.head_state
     preset = chain.preset
-    get_pubkey = state_pubkey_getter(state)
+    get_pubkey = chain.pubkey_cache.getter(state)
 
     survivors = []
     rejected = []
@@ -259,7 +258,8 @@ def batch_verify_contributions(
                 ),
             ]
             agg_set = sync_committee_contribution_signature_set(
-                state, signed, subkeys, preset, chain.spec
+                state, signed, subkeys, preset, chain.spec,
+                resolve_pubkey=chain.pubkey_cache.resolve,
             )
             if agg_set is not None:
                 sets.append(agg_set)

@@ -53,9 +53,13 @@ def _g2_infinity() -> Point:
 class PublicKey:
     """Validated G1 public key: on curve, in the subgroup, not infinity
     (key-validate per the IETF BLS spec; reference generic_public_key.rs).
-    `_tpu_limbs` caches the device limb tensor (jax_tpu backend)."""
+    `_tpu_limbs` caches the device limb tensor (jax_tpu backend);
+    `validator_index`/`table` are set by the chain's ValidatorPubkeyCache
+    so the batch verifier can gather limbs from the device-resident table
+    by index instead of packing host arrays (the steady-state marshaling
+    contract; reference validator_pubkey_cache.rs:10-23)."""
 
-    __slots__ = ("point", "_bytes", "_tpu_limbs")
+    __slots__ = ("point", "_bytes", "_tpu_limbs", "validator_index", "table")
 
     def __init__(self, point: Point, compressed: bytes | None = None):
         self.point = point

@@ -30,12 +30,16 @@ class BlockSignatureVerifier:
         spec,
         ctxt: ConsensusContext | None = None,
         get_pubkey=None,
+        resolve_pubkey=None,
     ):
         self.state = state
         self.preset = preset
         self.spec = spec
         self.ctxt = ctxt or ConsensusContext(preset, spec)
         self.get_pubkey = get_pubkey or state_pubkey_getter(state)
+        # bytes -> PublicKey for sync-committee participants; the chain
+        # plugs its pubkey cache here so keys stay table-tagged
+        self.resolve_pubkey = resolve_pubkey
         self.sets = []
 
     # include_* mirror block_signature_verifier.rs:141-340
@@ -104,7 +108,7 @@ class BlockSignatureVerifier:
         root = bytes(block.parent_root)
         s = sync_aggregate_signature_set(
             self.state,
-            None,
+            self.resolve_pubkey,
             sync_aggregate,
             block.slot,
             root,

@@ -254,7 +254,8 @@ def sync_aggregate_signature_set(
     root = SigningData(
         object_root=bytes(beacon_block_root), domain=domain
     ).tree_hash_root()
-    pubkeys = [_decompress(bytes(pk)) for pk in participants]
+    resolve = get_pubkey_bytes or _decompress
+    pubkeys = [resolve(bytes(pk)) for pk in participants]
     return SignatureSet.multiple_pubkeys(sig, pubkeys, root)
 
 
@@ -292,7 +293,8 @@ def contribution_and_proof_signature_set(
 
 
 def sync_committee_contribution_signature_set(
-    state, signed_contribution, subcommittee_pubkeys, preset, spec
+    state, signed_contribution, subcommittee_pubkeys, preset, spec,
+    resolve_pubkey=None,
 ) -> SignatureSet | None:
     contribution = signed_contribution.message.contribution
     bits = list(contribution.aggregation_bits)
@@ -309,5 +311,6 @@ def sync_committee_contribution_signature_set(
     root = SigningData(
         object_root=bytes(contribution.beacon_block_root), domain=domain
     ).tree_hash_root()
-    pubkeys = [_decompress(bytes(pk)) for pk in participants]
+    resolve = resolve_pubkey or _decompress
+    pubkeys = [resolve(bytes(pk)) for pk in participants]
     return SignatureSet.multiple_pubkeys(sig, pubkeys, root)

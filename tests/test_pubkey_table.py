@@ -1,0 +1,105 @@
+"""The device-resident pubkey table wired into the production verify path.
+
+Covers VERDICT r3 item 2: batches whose keys all come from the chain's
+ValidatorPubkeyCache are marshaled as validator INDICES (device gather),
+with zero per-key host limb packing on the hot path (reference
+validator_pubkey_cache.rs:10-23,79,131 -- decompress once, reference by
+index thereafter).
+"""
+
+from types import SimpleNamespace
+
+import pytest
+
+from lighthouse_tpu.chain.pubkey_cache import ValidatorPubkeyCache
+from lighthouse_tpu.crypto.bls import (
+    AggregateSignature,
+    SignatureSet,
+    set_backend,
+)
+from lighthouse_tpu.crypto.bls.backends import jax_tpu
+from lighthouse_tpu.types.interop import interop_keypair
+
+
+def _registry_state(n):
+    return SimpleNamespace(
+        validators=[
+            SimpleNamespace(pubkey=interop_keypair(i)[1].to_bytes())
+            for i in range(n)
+        ]
+    )
+
+
+def _tagged_sets(cache, n_sets=4, k=2):
+    sets = []
+    for i in range(n_sets):
+        msg = bytes([i]) * 32
+        idxs = [(i * k + j) % len(cache) for j in range(k)]
+        sks = [interop_keypair(ix)[0] for ix in idxs]
+        agg = AggregateSignature.aggregate([sk.sign(msg) for sk in sks])
+        sets.append(
+            SignatureSet.multiple_pubkeys(
+                agg.to_signature(), [cache.get(ix) for ix in idxs], msg
+            )
+        )
+    return sets
+
+
+@pytest.fixture(autouse=True)
+def _jax_backend():
+    set_backend("jax_tpu")
+    yield
+    set_backend("fake")
+
+
+def test_indexed_batch_verifies():
+    cache = ValidatorPubkeyCache(_registry_state(8))
+    sets = _tagged_sets(cache)
+    assert jax_tpu._common_table(sets) is cache
+    assert jax_tpu.verify_signature_sets(sets, seed=7)
+
+
+def test_indexed_batch_rejects_bad_signature():
+    cache = ValidatorPubkeyCache(_registry_state(8))
+    sets = _tagged_sets(cache)
+    # swap one set's message: its aggregate no longer matches
+    sets[2].message = b"\xff" * 32
+    assert not jax_tpu.verify_signature_sets(sets, seed=7)
+
+
+def test_hot_path_does_no_per_key_limb_packing(monkeypatch):
+    cache = ValidatorPubkeyCache(_registry_state(8))
+    sets = _tagged_sets(cache)
+    cache.device_table()  # upload happens here, once
+
+    def _boom(pk):
+        raise AssertionError("hot path packed host limbs for a pubkey")
+
+    monkeypatch.setattr(jax_tpu, "_pk_limbs", _boom)
+    assert jax_tpu.verify_signature_sets(sets, seed=7)
+
+
+def test_mixed_batch_falls_back_to_host_packing():
+    cache = ValidatorPubkeyCache(_registry_state(8))
+    sets = _tagged_sets(cache)
+    # one untagged key (e.g. a deposit outside the registry): generic path
+    sk, pk = interop_keypair(100)
+    msg = b"\x42" * 32
+    sets.append(SignatureSet.single_pubkey(sk.sign(msg), pk, msg))
+    assert jax_tpu._common_table(sets) is None
+    assert jax_tpu.verify_signature_sets(sets, seed=7)
+
+
+def test_import_new_pubkeys_extends_table():
+    state = _registry_state(4)
+    cache = ValidatorPubkeyCache(state)
+    assert len(cache) == 4
+    cache.device_table()
+    state.validators.append(
+        SimpleNamespace(pubkey=interop_keypair(4)[1].to_bytes())
+    )
+    assert cache.import_new_pubkeys(state) == 1
+    assert cache.get(4).validator_index == 4
+    assert int(cache.device_table().shape[0]) >= 5
+    # idempotent
+    assert cache.import_new_pubkeys(state) == 0

@@ -12,14 +12,8 @@ serialization, retry, and reorg handling over an actual socket.
 
 from __future__ import annotations
 
-import json
-import threading
-import time
-import urllib.error
-import urllib.request
-from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
-
 from ..types.containers import DepositData
+from ..utils.jsonrpc import JsonRpcClient, JsonRpcHttpServer
 from .service import Eth1Block
 
 DEPOSIT_CONTRACT_ADDRESS = "0x" + "12" * 20
@@ -103,37 +97,19 @@ class JsonRpcEth1Provider:
     ):
         self.url = url
         self.deposit_contract = deposit_contract
-        self.retries = retries
-        self.backoff_s = backoff_s
-        self.timeout_s = timeout_s
-        self._id = 0
+        self._rpc = JsonRpcClient(
+            url,
+            error_cls=Eth1RpcError,
+            retries=retries,
+            backoff_s=backoff_s,
+            timeout_s=timeout_s,
+        )
         # incremental log scan state (service.rs keeps the same watermark)
         self._scanned_to = -1
         self._logs: list = []  # (DepositData, index, block_number), by index
 
     def _call(self, method: str, params: list):
-        self._id += 1
-        payload = json.dumps(
-            {"jsonrpc": "2.0", "id": self._id, "method": method, "params": params}
-        ).encode()
-        last = None
-        for attempt in range(self.retries):
-            try:
-                req = urllib.request.Request(
-                    self.url,
-                    data=payload,
-                    headers={"Content-Type": "application/json"},
-                )
-                with urllib.request.urlopen(req, timeout=self.timeout_s) as resp:
-                    body = json.loads(resp.read())
-                if "error" in body and body["error"] is not None:
-                    raise Eth1RpcError(str(body["error"]))
-                return body["result"]
-            except (urllib.error.URLError, ConnectionError, OSError) as e:
-                last = e
-                if attempt < self.retries - 1:
-                    time.sleep(self.backoff_s * (2**attempt))
-        raise Eth1RpcError(f"eth1 rpc {method} failed after retries: {last}")
+        return self._rpc.call(method, params)
 
     # -- Eth1Service provider interface (service.py duck type) ---------------
 
@@ -206,49 +182,23 @@ class Eth1RpcServer:
 
     def __init__(self, chain, host: str = "127.0.0.1", port: int = 0):
         self.chain = chain
-        self.fail_next = 0
-        outer = self
+        self._http = JsonRpcHttpServer(self._dispatch, host=host, port=port)
+        self.url = self._http.url
 
-        class Handler(BaseHTTPRequestHandler):
-            def log_message(self, *args):
-                pass
+    @property
+    def fail_next(self) -> int:
+        return self._http.fail_next
 
-            def do_POST(self):
-                if outer.fail_next > 0:
-                    outer.fail_next -= 1
-                    self.send_error(503)
-                    return
-                length = int(self.headers.get("Content-Length", "0"))
-                req = json.loads(self.rfile.read(length))
-                try:
-                    result = outer._dispatch(req["method"], req.get("params", []))
-                    body = {"jsonrpc": "2.0", "id": req.get("id"), "result": result}
-                except Exception as e:  # noqa: BLE001
-                    body = {
-                        "jsonrpc": "2.0",
-                        "id": req.get("id"),
-                        "error": {"code": -32000, "message": str(e)},
-                    }
-                data = json.dumps(body).encode()
-                self.send_response(200)
-                self.send_header("Content-Type", "application/json")
-                self.send_header("Content-Length", str(len(data)))
-                self.end_headers()
-                self.wfile.write(data)
-
-        self._server = ThreadingHTTPServer((host, port), Handler)
-        self.url = f"http://{host}:{self._server.server_address[1]}"
-        self._thread = threading.Thread(
-            target=self._server.serve_forever, daemon=True
-        )
+    @fail_next.setter
+    def fail_next(self, n: int) -> None:
+        self._http.fail_next = n
 
     def start(self):
-        self._thread.start()
+        self._http.start()
         return self
 
     def stop(self):
-        self._server.shutdown()
-        self._server.server_close()
+        self._http.stop()
 
     def _dispatch(self, method: str, params: list):
         chain = self.chain

@@ -1,6 +1,13 @@
 """Execution-layer interface: engine API types, the ExecutionLayer facade,
-and the in-process mock engine (reference beacon_node/execution_layer)."""
+the JWT-authenticated HTTP transport, payload block-hash verification, and
+the in-process mock engine (reference beacon_node/execution_layer)."""
 
+from .auth import JwtError, JwtKey, generate_token, validate_token
+from .block_hash import (
+    calculate_execution_block_hash,
+    calculate_transactions_root,
+    verify_payload_block_hash,
+)
 from .engine_api import (
     EngineApiError,
     ExecutionEngine,
@@ -15,18 +22,28 @@ from .execution_layer import (
     PayloadInvalid,
     PayloadVerificationStatus,
 )
+from .http_engine import EngineRpcServer, HttpJsonRpcEngine
 from .mock_engine import MockExecutionEngine
 
 __all__ = [
     "EngineApiError",
+    "EngineRpcServer",
     "ExecutionEngine",
     "ExecutionLayer",
     "ForkchoiceState",
     "ForkchoiceUpdatedResponse",
+    "HttpJsonRpcEngine",
+    "JwtError",
+    "JwtKey",
     "MockExecutionEngine",
     "PayloadAttributes",
     "PayloadInvalid",
     "PayloadStatusV1",
     "PayloadStatusV1Status",
     "PayloadVerificationStatus",
+    "calculate_execution_block_hash",
+    "calculate_transactions_root",
+    "generate_token",
+    "validate_token",
+    "verify_payload_block_hash",
 ]

@@ -39,9 +39,22 @@ class PayloadInvalid(ValueError):
 
 
 class ExecutionLayer:
-    def __init__(self, engine, suggested_fee_recipient: bytes = b"\x00" * 20):
+    def __init__(
+        self,
+        engine,
+        suggested_fee_recipient: bytes = b"\x00" * 20,
+        pre_merge_parent_hash: bytes | None = None,
+    ):
         self.engine = engine
         self.suggested_fee_recipient = suggested_fee_recipient
+        # the EL block to build the transition payload on before the merge
+        # completes (terminal block seat); in-process mocks default to their
+        # own genesis, remote engines must be told explicitly
+        self.pre_merge_parent_hash = (
+            pre_merge_parent_hash
+            if pre_merge_parent_hash is not None
+            else getattr(engine, "genesis_hash", None)
+        )
         # per-proposer fee recipients pushed by VCs (reference
         # execution_layer proposer_preparation_data, fed by the VC's
         # preparation_service.rs prepare_beacon_proposer calls)
@@ -62,6 +75,16 @@ class ExecutionLayer:
     # -- verification path (block import) -----------------------------------
 
     def notify_new_payload(self, payload) -> PayloadVerificationStatus:
+        # the block-hash check runs LOCALLY before any engine round trip
+        # (block_hash.rs via block_verification.rs): a payload whose header
+        # doesn't keccak to its claimed hash is invalid no matter what a
+        # (possibly lying) engine says, and never reaches the wire
+        from .block_hash import verify_payload_block_hash
+
+        try:
+            verify_payload_block_hash(payload)
+        except ValueError as e:
+            raise PayloadInvalid(str(e)) from None
         status = self.engine.new_payload(payload)
         s = status.status
         if s == PayloadStatusV1Status.VALID:
@@ -117,8 +140,12 @@ class ExecutionLayer:
         if is_merge_transition_complete(state):
             parent_hash = bytes(state.latest_execution_payload_header.block_hash)
         else:
-            # mock merge transition: build on the EL's genesis block
-            parent_hash = self.engine.genesis_hash
+            # merge transition: build on the configured terminal EL block
+            if self.pre_merge_parent_hash is None:
+                raise EngineApiError(
+                    "pre-merge payload requested with no terminal parent configured"
+                )
+            parent_hash = self.pre_merge_parent_hash
         epoch = compute_epoch_at_slot(slot, preset)
         return self.get_payload(
             parent_hash,

@@ -139,18 +139,18 @@ class MockExecutionEngine(ExecutionEngine):
 
     # -- internals -----------------------------------------------------------
 
+    @property
+    def payload_cls(self):
+        return self.t.ExecutionPayload
+
     def compute_block_hash(self, payload) -> bytes:
-        """Deterministic mock block hash over the payload's identity fields
-        (the reference hashes RLP headers with keccak, block_hash.rs; the
-        mock only needs consistency between producer and verifier)."""
-        return _hash(
-            b"mock-el-block"
-            + bytes(payload.parent_hash)
-            + int(payload.block_number).to_bytes(8, "little")
-            + int(payload.timestamp).to_bytes(8, "little")
-            + bytes(payload.prev_randao)
-            + bytes(payload.fee_recipient)
-        )
+        """REAL keccak-over-RLP-header hash (block_hash.rs), exactly what
+        the beacon node's verify_payload_block_hash recomputes -- the mock
+        chain is indistinguishable from a hash-honest engine (the
+        reference's execution_block_generator does the same)."""
+        from .block_hash import calculate_execution_block_hash
+
+        return calculate_execution_block_hash(payload)
 
     def _build_payload(self, parent_hash: bytes, attrs: PayloadAttributes):
         parent = self.blocks.get(parent_hash)

@@ -1,0 +1,308 @@
+"""Engine-API HTTP transport, JWT auth, and keccak/RLP block-hash
+verification (VERDICT r3 item 3; reference execution_layer/src/engine_api/
+{http.rs,auth.rs} + block_hash.rs). The in-process EngineRpcServer fronts
+the mock engine behind a REAL socket with live JWT validation, mirroring
+the eth1 client/rig split."""
+
+import pytest
+
+from lighthouse_tpu.crypto.bls import set_backend
+from lighthouse_tpu.execution_layer import (
+    EngineApiError,
+    EngineRpcServer,
+    ExecutionLayer,
+    HttpJsonRpcEngine,
+    JwtError,
+    JwtKey,
+    MockExecutionEngine,
+    PayloadInvalid,
+    PayloadVerificationStatus,
+    calculate_execution_block_hash,
+    calculate_transactions_root,
+    generate_token,
+    validate_token,
+    verify_payload_block_hash,
+)
+from lighthouse_tpu.execution_layer.keccak import keccak256
+from lighthouse_tpu.execution_layer.rlp import (
+    EMPTY_TRIE_ROOT,
+    encode_bytes,
+    encode_int,
+    encode_list,
+    ordered_trie_root,
+)
+from lighthouse_tpu.types import MINIMAL, types_for
+
+
+@pytest.fixture(autouse=True)
+def fake_crypto():
+    set_backend("fake")
+    yield
+    set_backend("jax_tpu")
+
+
+# --- keccak + rlp known-answer vectors (public) ------------------------------
+
+
+class TestKeccakRlp:
+    def test_keccak_vectors(self):
+        assert (
+            keccak256(b"").hex()
+            == "c5d2460186f7233c927e7db2dcc703c0e500b653ca82273b7bfad8045d85a470"
+        )
+        assert (
+            keccak256(b"abc").hex()
+            == "4e03657aea45a94fc7d47ba826c8d667c0d1e6e33a64a036ec44f58fa12d6c45"
+        )
+        # exactly rate-1 bytes: the single-byte 0x81 padding branch
+        assert len(keccak256(b"a" * 135)) == 32
+
+    def test_permutation_differential_vs_hashlib_sha3(self):
+        """SHA3-256 differs from keccak-256 only in the padding domain
+        byte; driving NIST padding through OUR sponge and comparing to
+        hashlib anchors the Keccak-f[1600] permutation, absorb, and
+        squeeze against an independent implementation for many lengths
+        (incl. the rate-1 one-byte-padding edge)."""
+        import hashlib
+
+        from lighthouse_tpu.execution_layer.keccak import sha3_256
+
+        for n in (0, 1, 31, 32, 33, 64, 135, 136, 137, 271, 272, 1000):
+            data = bytes((i * 31 + n) % 256 for i in range(n))
+            assert (
+                sha3_256(data) == hashlib.sha3_256(data).digest()
+            ), f"sponge diverges from hashlib at len {n}"
+
+    def test_rlp_vectors(self):
+        assert encode_bytes(b"dog") == b"\x83dog"
+        assert (
+            encode_list([encode_bytes(b"cat"), encode_bytes(b"dog")])
+            == b"\xc8\x83cat\x83dog"
+        )
+        assert encode_bytes(b"") == b"\x80"
+        assert encode_int(0) == b"\x80"
+        assert encode_int(15) == b"\x0f"
+        assert encode_int(1024) == b"\x82\x04\x00"
+        lorem = b"Lorem ipsum dolor sit amet, consectetur adipisicing elit"
+        assert encode_bytes(lorem) == b"\xb8\x38" + lorem
+
+    def test_empty_constants(self):
+        assert (
+            EMPTY_TRIE_ROOT.hex()
+            == "56e81f171bcc55a6ff8345e692c0f86e5b48e01b996cadc001622fb5e363b421"
+        )
+        # empty ommers list: keccak(rlp([]))
+        assert (
+            keccak256(encode_list([])).hex()
+            == "1dcc4de8dec75d7aab85b567b6ccd41ad312451b948a7413f0a142fd40d49347"
+        )
+
+    def test_ordered_trie_shapes(self):
+        # deterministic, order-sensitive, collision-free across sizes that
+        # exercise leaf / branch / extension / embedded-node paths
+        roots = set()
+        for n in (0, 1, 2, 16, 17, 200):
+            vals = [bytes([i % 251]) * (1 + i % 40) for i in range(n)]
+            r = ordered_trie_root(vals)
+            assert len(r) == 32
+            roots.add(r)
+        assert len(roots) == 6
+        # value order matters
+        a = ordered_trie_root([b"one", b"two"])
+        b = ordered_trie_root([b"two", b"one"])
+        assert a != b
+
+    def test_single_entry_trie_literal_derivation(self):
+        """Yellow-paper derivation spelled out in literal bytes: one entry
+        keyed rlp(0)=0x80, nibbles [8,0], even-length leaf -> hex-prefix
+        0x20 0x80; node = rlp([HP, value]); root = keccak(node). Guards
+        the HP packing and leaf-encoding rules against drift. (A live
+        cross-check against a real engine's transactionsRoot needs
+        network access; the rig's producer/verifier both use this code.)"""
+        value = b"a-transaction-payload-over-32-bytes-long"
+        hp = b"\x20\x80"
+        node = encode_list([encode_bytes(hp), encode_bytes(value)])
+        assert ordered_trie_root([value]) == keccak256(node)
+
+
+# --- JWT ---------------------------------------------------------------------
+
+
+class TestJwt:
+    def test_round_trip(self):
+        key = JwtKey.random()
+        claims = validate_token(key, generate_token(key))
+        assert "iat" in claims
+
+    def test_wrong_key_rejected(self):
+        token = generate_token(JwtKey.random())
+        with pytest.raises(JwtError, match="signature"):
+            validate_token(JwtKey.random(), token)
+
+    def test_stale_iat_rejected(self):
+        key = JwtKey.random()
+        token = generate_token(key, now=1000.0)
+        with pytest.raises(JwtError, match="stale"):
+            validate_token(key, token, now=2000.0)
+        # inside the window passes
+        validate_token(key, token, now=1030.0)
+
+    def test_malformed(self):
+        key = JwtKey.random()
+        with pytest.raises(JwtError):
+            validate_token(key, "not.a")
+        with pytest.raises(JwtError):
+            JwtKey(b"\x01" * 8)
+        k2 = JwtKey.from_hex("0x" + "ab" * 32)
+        assert k2.to_hex() == "0x" + "ab" * 32
+
+
+# --- block hash --------------------------------------------------------------
+
+
+class TestBlockHash:
+    def _payload(self, **overrides):
+        t = types_for(MINIMAL)
+        p = t.ExecutionPayload(
+            parent_hash=b"\x11" * 32,
+            fee_recipient=b"\x22" * 20,
+            state_root=b"\x33" * 32,
+            receipts_root=b"\x44" * 32,
+            prev_randao=b"\x55" * 32,
+            block_number=7,
+            gas_limit=30_000_000,
+            gas_used=21_000,
+            timestamp=123456,
+            extra_data=b"tpu",
+            base_fee_per_gas=7,
+            transactions=[b"\x02\xf8\x70" + b"\x00" * 40, b"\xf8\x6b" + b"\x01" * 30],
+        )
+        for k, v in overrides.items():
+            setattr(p, k, v)
+        p.block_hash = calculate_execution_block_hash(p)
+        return p
+
+    def test_verify_ok_and_tamper_detected(self):
+        p = self._payload()
+        verify_payload_block_hash(p)
+        p.gas_used = 22_000  # header field changed, hash now stale
+        with pytest.raises(ValueError, match="mismatch"):
+            verify_payload_block_hash(p)
+
+    def test_transactions_bound_into_hash(self):
+        p = self._payload()
+        q = self._payload()
+        q.transactions = list(q.transactions)[:1]
+        q.block_hash = calculate_execution_block_hash(q)
+        assert bytes(p.block_hash) != bytes(q.block_hash)
+        assert calculate_transactions_root([]) == EMPTY_TRIE_ROOT
+
+    def test_mock_engine_uses_real_hash(self):
+        t = types_for(MINIMAL)
+        engine = MockExecutionEngine(t)
+        el = ExecutionLayer(engine)
+        p = el.get_payload(engine.genesis_hash, 1234, b"\x07" * 32)
+        assert bytes(p.block_hash) == calculate_execution_block_hash(p)
+
+
+# --- HTTP transport ----------------------------------------------------------
+
+
+@pytest.fixture()
+def rig():
+    t = types_for(MINIMAL)
+    engine = MockExecutionEngine(t)
+    key = JwtKey.random()
+    server = EngineRpcServer(engine, key).start()
+    client = HttpJsonRpcEngine(
+        server.url, key, t.ExecutionPayload, backoff_s=0.01
+    )
+    yield engine, server, client
+    server.stop()
+
+
+class TestHttpTransport:
+    def test_full_verb_round_trip(self, rig):
+        engine, server, client = rig
+        el = ExecutionLayer(client)
+        p = el.get_payload(engine.genesis_hash, 1234, b"\x07" * 32)
+        assert bytes(p.parent_hash) == engine.genesis_hash
+        assert el.notify_new_payload(p) is PayloadVerificationStatus.VERIFIED
+        # head moved on the engine side through the socket
+        el.notify_forkchoice_updated(bytes(p.block_hash))
+        assert engine.head_hash == bytes(p.block_hash)
+
+    def test_tampered_hash_rejected_before_the_wire(self, rig):
+        engine, server, client = rig
+        el = ExecutionLayer(client)
+        p = el.get_payload(engine.genesis_hash, 1234, b"\x07" * 32)
+        p.block_hash = b"\x99" * 32
+        seen_before = server.requests_seen
+        with pytest.raises(PayloadInvalid, match="mismatch"):
+            el.notify_new_payload(p)
+        # the lying payload never reached the engine
+        assert server.requests_seen == seen_before
+
+    def test_transient_503_retried(self, rig):
+        engine, server, client = rig
+        server.fail_next = 2
+        el = ExecutionLayer(client)
+        p = el.get_payload(engine.genesis_hash, 99, b"\x01" * 32)
+        assert int(p.timestamp) == 99
+
+    def test_persistent_failure_surfaces(self, rig):
+        engine, server, client = rig
+        server.fail_next = 10
+        with pytest.raises(EngineApiError, match="after retries"):
+            client.forkchoice_updated(
+                __import__(
+                    "lighthouse_tpu.execution_layer", fromlist=["ForkchoiceState"]
+                ).ForkchoiceState(head_block_hash=engine.genesis_hash)
+            )
+
+    def test_bad_jwt_rejected(self, rig):
+        engine, server, _ = rig
+        t = types_for(MINIMAL)
+        impostor = HttpJsonRpcEngine(
+            server.url, JwtKey.random(), t.ExecutionPayload,
+            retries=1, backoff_s=0.01,
+        )
+        with pytest.raises(EngineApiError):
+            impostor.get_payload(b"\x01" * 8)
+
+    def test_invalid_payload_status_crosses_the_wire(self, rig):
+        engine, server, client = rig
+        el = ExecutionLayer(client)
+        p = el.get_payload(engine.genesis_hash, 1234, b"\x07" * 32)
+        engine.mark_invalid(bytes(p.block_hash))
+        with pytest.raises(PayloadInvalid):
+            el.notify_new_payload(p)
+
+
+# --- chain-level: bellatrix import through the authenticated socket ---------
+
+
+def test_chain_imports_through_http_engine():
+    from lighthouse_tpu.harness import BeaconChainHarness
+    from lighthouse_tpu.types import ChainSpec
+
+    t = types_for(MINIMAL)
+    engine = MockExecutionEngine(t)
+    key = JwtKey.random()
+    server = EngineRpcServer(engine, key).start()
+    try:
+        client = HttpJsonRpcEngine(
+            server.url, key, t.ExecutionPayload, backoff_s=0.01
+        )
+        el = ExecutionLayer(client, pre_merge_parent_hash=engine.genesis_hash)
+        spec = ChainSpec.interop(altair_fork_epoch=1, bellatrix_fork_epoch=2)
+        h = BeaconChainHarness(16, MINIMAL, spec, sign=False, execution_layer=el)
+        # cross phase0 -> altair -> bellatrix; payload blocks round-trip
+        # through the authenticated socket during import
+        h.extend_chain(3 * MINIMAL.slots_per_epoch)
+        state = h.chain.head_state
+        assert state.fork_name == "bellatrix"
+        assert int(state.latest_execution_payload_header.block_number) > 0
+        assert server.requests_seen > 0
+    finally:
+        server.stop()

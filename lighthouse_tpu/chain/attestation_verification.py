@@ -40,12 +40,15 @@ class VerifiedUnaggregated:
     attestation: object
     indexed_indices: list
     attester_index: int
+    # the full IndexedAttestation (slasher feed; the batch already built it)
+    indexed: object = None
 
 
 @dataclass
 class VerifiedAggregate:
     signed_aggregate: object
     indexed_indices: list
+    indexed: object = None
 
 
 def is_aggregator(committee_len: int, selection_proof: bytes, spec) -> bool:
@@ -106,9 +109,7 @@ def _setup_unaggregated_batch(
             s = indexed_attestation_signature_set(
                 state, get_pubkey, indexed, chain.preset, chain.spec
             )
-            survivors.append(
-                (att, s, list(indexed.attesting_indices), attester)
-            )
+            survivors.append((att, s, indexed, attester))
         except (AttestationError, ValueError) as e:
             rejected.append((att, str(e)))
 
@@ -147,9 +148,13 @@ def batch_verify_unaggregated(
                     ok_items.append(item)
                 else:
                     rejected.append((item[0], "invalid signature"))
-        for att, _, indices, attester in ok_items:
+        for att, _, indexed, attester in ok_items:
             observed_attesters.observe(att.data.target.epoch, attester)
-            verified.append(VerifiedUnaggregated(att, indices, attester))
+            verified.append(
+                VerifiedUnaggregated(
+                    att, list(indexed.attesting_indices), attester, indexed
+                )
+            )
         M.ATTESTATIONS_PROCESSED.inc(len(verified))
         if chain.validator_monitor is not None:
             for v in verified:
@@ -240,7 +245,7 @@ def batch_verify_aggregates(
                     state, get_pubkey, indexed, chain.preset, chain.spec
                 ),
             ]
-            survivors.append((agg, sets, list(indexed.attesting_indices)))
+            survivors.append((agg, sets, indexed))
         except (AttestationError, ValueError) as e:
             rejected.append((agg, str(e)))
 
@@ -256,11 +261,15 @@ def batch_verify_aggregates(
                     ok_items.append(item)
                 else:
                     rejected.append((item[0], "invalid signature"))
-        for agg, _, indices in ok_items:
+        for agg, _, indexed in ok_items:
             epoch = agg.message.aggregate.data.target.epoch
             observed_aggregates.observe(
                 epoch, agg.message.aggregate.tree_hash_root()
             )
             observed_aggregators.observe(epoch, agg.message.aggregator_index)
-            verified.append(VerifiedAggregate(agg, indices))
+            verified.append(
+                VerifiedAggregate(
+                    agg, list(indexed.attesting_indices), indexed
+                )
+            )
     return verified, rejected

@@ -92,6 +92,34 @@ class NetworkNode:
         bus.subscribe(
             peer_id, self._topic_contribution, self._on_gossip_contribution
         )
+        # operation gossip topics (types/topics.rs: ProposerSlashing /
+        # AttesterSlashing / VoluntaryExit pubsub kinds)
+        self._topic_proposer_slashing = topic_name(
+            "proposer_slashing", self.fork_digest
+        )
+        self._topic_attester_slashing = topic_name(
+            "attester_slashing", self.fork_digest
+        )
+        self._topic_voluntary_exit = topic_name(
+            "voluntary_exit", self.fork_digest
+        )
+        bus.subscribe(
+            peer_id,
+            self._topic_proposer_slashing,
+            self._on_gossip_proposer_slashing,
+        )
+        bus.subscribe(
+            peer_id,
+            self._topic_attester_slashing,
+            self._on_gossip_attester_slashing,
+        )
+        bus.subscribe(
+            peer_id, self._topic_voluntary_exit, self._on_gossip_voluntary_exit
+        )
+        # dedup for op gossip (observed_operations.rs)
+        self._seen_ops: set[bytes] = set()
+        # optional slasher (slasher/service/src/lib.rs); attach_slasher wires it
+        self.slasher_service = None
         for subnet in range(chain.preset.sync_committee_subnet_count):
             bus.subscribe(
                 peer_id,
@@ -150,6 +178,158 @@ class NetworkNode:
                 "gossip_sync_contribution", (signed_contribution, source)
             )
 
+    # -- slasher (slasher/service/src/lib.rs) -------------------------------
+
+    def attach_slasher(self, slasher) -> None:
+        """Run a slasher on this node: verified gossip feeds it, and its
+        detections are pooled for block inclusion + broadcast on the
+        slashing topics."""
+        from ..slasher import SlasherService
+
+        def broadcast(kind, op):
+            topic = (
+                self._topic_attester_slashing
+                if kind == "attester_slashing"
+                else self._topic_proposer_slashing
+            )
+            self._seen_ops.add(op.tree_hash_root())  # don't re-import our own
+            self.bus.publish(self.peer_id, topic, op)
+
+        self.slasher_service = SlasherService(slasher, self.op_pool, broadcast)
+
+    def on_slot(self) -> None:
+        """Per-slot housekeeping (the reference's per-12s slasher batch)."""
+        if self.slasher_service is not None:
+            self.slasher_service.update()
+
+    # -- operation gossip (verify_operation.rs + observed_operations.rs) ---
+
+    def _op_fresh(self, op) -> bool:
+        root = op.tree_hash_root()
+        if root in self._seen_ops:
+            return False
+        self._seen_ops.add(root)
+        return True
+
+    def _handle_op_gossip(self, op, source: str, validate, insert) -> None:
+        """Shared op-gossip flow: dedup AFTER validation (the repo's
+        observe-after-verification pattern -- a transiently-unverifiable op
+        must be retryable on re-gossip), and distinguish ignore (our view
+        is behind: no penalty) from reject (provably bad: penalize)."""
+        if self.is_banned(source) or op.tree_hash_root() in self._seen_ops:
+            return
+        from ..chain.pubkey_cache import PubkeyCacheError
+
+        try:
+            validate(op)
+        except (KeyError, IndexError, PubkeyCacheError):
+            return  # references state we don't have yet: ignore, may recur
+        except ValueError:
+            self.penalize(source)
+            return
+        self._seen_ops.add(op.tree_hash_root())
+        insert(op)
+
+    def _on_gossip_proposer_slashing(self, slashing, source: str) -> None:
+        self._handle_op_gossip(
+            slashing,
+            source,
+            self._validate_proposer_slashing,
+            self.op_pool.insert_proposer_slashing,
+        )
+
+    def _on_gossip_attester_slashing(self, slashing, source: str) -> None:
+        self._handle_op_gossip(
+            slashing,
+            source,
+            self._validate_attester_slashing,
+            self.op_pool.insert_attester_slashing,
+        )
+
+    def _on_gossip_voluntary_exit(self, signed_exit, source: str) -> None:
+        self._handle_op_gossip(
+            signed_exit,
+            source,
+            self._validate_voluntary_exit,
+            self.op_pool.insert_voluntary_exit,
+        )
+
+    def _validate_proposer_slashing(self, slashing) -> None:
+        from ..crypto.bls import verify_signature_sets
+        from ..state_transition.signature_sets import (
+            proposer_slashing_signature_sets,
+        )
+
+        h1 = slashing.signed_header_1.message
+        h2 = slashing.signed_header_2.message
+        if h1.slot != h2.slot or h1.proposer_index != h2.proposer_index:
+            raise ValueError("headers not slashable")
+        if h1.tree_hash_root() == h2.tree_hash_root():
+            raise ValueError("identical headers")
+        state = self.chain.head_state
+        sets = proposer_slashing_signature_sets(
+            state,
+            self.chain.pubkey_cache.getter(state),
+            slashing,
+            self.chain.preset,
+            self.chain.spec,
+        )
+        if not verify_signature_sets(sets):
+            raise ValueError("bad proposer slashing signature")
+
+    def _validate_attester_slashing(self, slashing) -> None:
+        from ..crypto.bls import verify_signature_sets
+        from ..state_transition.per_block import is_slashable_attestation_data
+        from ..state_transition.signature_sets import (
+            attester_slashing_signature_sets,
+        )
+
+        a1, a2 = slashing.attestation_1, slashing.attestation_2
+        if not is_slashable_attestation_data(a1.data, a2.data):
+            raise ValueError("attestation data not slashable")
+        if not set(a1.attesting_indices) & set(a2.attesting_indices):
+            raise ValueError("no common attesters")
+        state = self.chain.head_state
+        sets = attester_slashing_signature_sets(
+            state,
+            self.chain.pubkey_cache.getter(state),
+            slashing,
+            self.chain.preset,
+            self.chain.spec,
+        )
+        if not verify_signature_sets(sets):
+            raise ValueError("bad attester slashing signature")
+
+    def _validate_voluntary_exit(self, signed_exit) -> None:
+        """The FULL process_voluntary_exit precondition set (per_block.py):
+        a validly-signed but premature exit must never reach the pool, or
+        it bricks every subsequent pool-packed block."""
+        from ..crypto.bls import verify_signature_sets
+        from ..state_transition.signature_sets import exit_signature_set
+        from ..types import FAR_FUTURE_EPOCH, is_active_validator
+
+        state = self.chain.head_state
+        msg = signed_exit.message
+        epoch = compute_epoch_at_slot(state.slot, self.chain.preset)
+        v = state.validators[msg.validator_index]
+        if not is_active_validator(v, epoch):
+            raise ValueError("exiting validator not active")
+        if v.exit_epoch != FAR_FUTURE_EPOCH:
+            raise ValueError("validator already exiting")
+        if epoch < msg.epoch:
+            raise ValueError("exit epoch in the future")
+        if epoch < v.activation_epoch + self.chain.spec.shard_committee_period:
+            raise ValueError("validator too young to exit")
+        s = exit_signature_set(
+            state,
+            self.chain.pubkey_cache.getter(state),
+            signed_exit,
+            self.chain.preset,
+            self.chain.spec,
+        )
+        if not verify_signature_sets([s]):
+            raise ValueError("bad exit signature")
+
     # -- workers (worker/gossip_methods.rs) ---------------------------------
 
     def _work_block(self, item) -> None:
@@ -172,12 +352,16 @@ class NetworkNode:
                     process_gossip_block(self.chain, signed_block)
                 except BlockError:
                     self.penalize(source)
+                    return
             else:
                 self.penalize(source, -1)
+                return
         except BlockError:
             self.penalize(source)
             return
         # mesh re-publication happens at the bus; nothing further here
+        if self.slasher_service is not None:
+            self.slasher_service.accept_block(signed_block)
 
     def _work_aggregates(self, items) -> None:
         aggs = [a for a, _ in items]
@@ -193,6 +377,8 @@ class NetworkNode:
             self.chain.apply_attestation(
                 v.signed_aggregate.message.aggregate, v.indexed_indices
             )
+            if self.slasher_service is not None:
+                self.slasher_service.accept_attestation(v.indexed)
         for agg, reason in rejected:
             if "signature" in reason or "selection" in reason:
                 self.penalize(sources.get(id(agg), ""))
@@ -207,6 +393,8 @@ class NetworkNode:
             self.naive_pool.insert(v.attestation)
             self.op_pool.insert_attestation(v.attestation)
             self.chain.apply_attestation(v.attestation, v.indexed_indices)
+            if self.slasher_service is not None:
+                self.slasher_service.accept_attestation(v.indexed)
         for att, reason in rejected:
             if "signature" in reason:
                 self.penalize(sources.get(id(att), ""))
@@ -242,7 +430,14 @@ class NetworkNode:
 
     def publish_block(self, signed_block) -> None:
         self.chain.process_block(signed_block)
+        if self.slasher_service is not None:
+            self.slasher_service.accept_block(signed_block)
         self.bus.publish(self.peer_id, self._topic_block, signed_block)
+
+    def publish_voluntary_exit(self, signed_exit) -> None:
+        self._op_fresh(signed_exit)
+        self.op_pool.insert_voluntary_exit(signed_exit)
+        self.bus.publish(self.peer_id, self._topic_voluntary_exit, signed_exit)
 
     def publish_attestation(self, attestation, subnet: int = 0) -> None:
         self.naive_pool.insert(attestation)

@@ -43,6 +43,7 @@ class Simulator:
         for n in self.nodes:
             n.chain.slot_clock.set_slot(slot)
             n.chain.on_tick()
+            n.on_slot()  # slasher batch + other per-slot services
 
     def run_slot(self, slot: int, attest: bool = True) -> None:
         """One slot of the synthetic network: the proposer's node produces

@@ -146,6 +146,9 @@ class OperationPool:
                 is_active_validator(v, epoch)
                 and v.exit_epoch == FAR_FUTURE_EPOCH
                 and op.message.epoch <= epoch
+                # process_voluntary_exit's age gate: packing a too-young
+                # exit would invalidate the produced block
+                and epoch >= v.activation_epoch + self.spec.shard_committee_period
             )
 
         exits = [e for e in self._voluntary_exits.values() if exitable(e)][

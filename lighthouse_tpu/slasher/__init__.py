@@ -2,4 +2,5 @@
 batched double-vote/surround/double-proposal detection feeding the
 operation pool."""
 
+from .service import SlasherService  # noqa: F401
 from .slasher import Slasher  # noqa: F401

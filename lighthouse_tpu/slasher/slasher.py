@@ -236,12 +236,17 @@ class Slasher:
             if prior is not None and prior[0] != att_root:
                 out.append((v, self._decode_indexed(prior[1]), indexed))
                 continue
-            # surround checks via the running arrays
+            # Surround checks via the running arrays. AttesterSlashing
+            # order matters: is_slashable_attestation_data (spec) requires
+            # attestation_1 to be the SURROUNDING vote
+            # (source_1 < source_2 and target_2 < target_1).
             if s + 1 < self.history and self.min_target.get(v, s + 1) < t:
+                # prior has source' > s and target' < t: NEW surrounds PRIOR
                 culprit = self._find_record(v, lambda pt: pt[1] < t and pt[0] > s)
                 if culprit is not None:
-                    out.append((v, culprit, indexed))
+                    out.append((v, indexed, culprit))
             if s >= 1 and self.max_target.get(v, s - 1) > t:
+                # prior has source' < s and target' > t: PRIOR surrounds NEW
                 culprit = self._find_record(v, lambda pt: pt[1] > t and pt[0] < s)
                 if culprit is not None:
                     out.append((v, culprit, indexed))
@@ -268,8 +273,8 @@ class Slasher:
 
         t = types_for(self.preset)
         return [
-            t.AttesterSlashing(attestation_1=prior, attestation_2=new)
-            for _, prior, new in detections
+            t.AttesterSlashing(attestation_1=att_1, attestation_2=att_2)
+            for _, att_1, att_2 in detections
         ]
 
     # -- block detection -----------------------------------------------------

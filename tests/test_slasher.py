@@ -66,6 +66,7 @@ class TestAttestations:
         s.accept_attestation(indexed([5], 2, 6, b"\x0c"))  # surrounds (3,4)
         atts, _ = s.process_queued()
         assert len(atts) == 1
+        self._assert_spec_slashable(atts[0])
 
     def test_surround_detected_new_surrounded_by_old(self):
         s = make()
@@ -74,6 +75,20 @@ class TestAttestations:
         s.accept_attestation(indexed([7], 3, 4, b"\x0d"))  # surrounded by (2,6)
         atts, _ = s.process_queued()
         assert len(atts) == 1
+        self._assert_spec_slashable(atts[0])
+
+    @staticmethod
+    def _assert_spec_slashable(slashing):
+        """Regression: attestation_1 must be the SURROUNDING vote, or the
+        emitted AttesterSlashing fails the spec predicate and would
+        invalidate any block that includes it."""
+        from lighthouse_tpu.state_transition.per_block import (
+            is_slashable_attestation_data,
+        )
+
+        assert is_slashable_attestation_data(
+            slashing.attestation_1.data, slashing.attestation_2.data
+        )
 
     def test_innocent_attestations_pass(self):
         s = make()

@@ -1,0 +1,192 @@
+"""Slasher wired into the node (VERDICT r3 item 4; reference
+slasher/service/src/lib.rs): verified gossip feeds the slasher, per-slot
+batches detect equivocations, detections land in the op pool AND on the
+slashing gossip topics, and the next produced block carries the slashing
+to chain-level justice."""
+
+import pytest
+
+from lighthouse_tpu.crypto.bls import INFINITY_SIGNATURE, set_backend
+from lighthouse_tpu.network.simulator import Simulator
+from lighthouse_tpu.slasher import Slasher
+from lighthouse_tpu.types import MINIMAL, types_for
+from lighthouse_tpu.validator_client.beacon_node import InProcessBeaconNode
+
+
+@pytest.fixture(autouse=True)
+def fake_crypto():
+    set_backend("fake")
+    yield
+    set_backend("jax_tpu")
+
+
+def _sim_with_slasher(nodes=2, validators=32):
+    sim = Simulator(nodes, validators, MINIMAL)
+    sim.nodes[0].attach_slasher(Slasher(MINIMAL, sim.spec))
+    return sim
+
+
+def _produce_with_pool(node, slot):
+    """Produce + sign a block through the pool-packing production path
+    (the VC-facing endpoint that draws slashings from the op pool)."""
+    bn = InProcessBeaconNode(node.chain, op_pool=node.op_pool)
+    t = types_for(MINIMAL)
+    block = bn.produce_block(slot, INFINITY_SIGNATURE)
+    fork = node.chain.head_state.fork_name
+    from lighthouse_tpu.types.containers import block_classes_for
+
+    _, signed_cls, _ = block_classes_for(t, fork)
+    return signed_cls(message=block, signature=INFINITY_SIGNATURE)
+
+
+class TestProposerEquivocation:
+    def test_equivocating_proposer_slashed_in_produced_block(self):
+        sim = _sim_with_slasher()
+        node0, node1 = sim.nodes
+        sim.run_slot(1)
+        sim.run_slot(2)
+
+        # the slot-3 proposer signs TWO different blocks (different bodies)
+        parent = node0.chain._states[node0.chain.head_root]
+        atts = sim.producer.attestations_for_slot(
+            __import__(
+                "lighthouse_tpu.state_transition", fromlist=["process_slots"]
+            ).process_slots(
+                __import__(
+                    "lighthouse_tpu.state_transition", fromlist=["clone_state"]
+                ).clone_state(parent),
+                3,
+                MINIMAL,
+                sim.spec,
+            ),
+            2,
+        )
+        sim.tick(3)
+        block_a, _ = sim.producer.produce_block(3, atts, base_state=parent)
+        block_b, _ = sim.producer.produce_block(3, (), base_state=parent)
+        assert (
+            block_a.message.tree_hash_root() != block_b.message.tree_hash_root()
+        )
+        proposer = block_a.message.proposer_index
+        node0.publish_block(block_a)
+        node0.publish_block(block_b)  # the equivocation (a fork)
+        sim.drain()
+
+        # slot 4 tick runs the slasher batch: detection -> pool + gossip
+        sim.tick(4)
+        svc = node0.slasher_service
+        assert svc.proposer_slashings_found == 1
+        assert proposer in node0.op_pool._proposer_slashings
+        # the broadcast crossed the bus into the other node's pool
+        assert proposer in node1.op_pool._proposer_slashings
+
+        # the next pool-packed block carries the slashing...
+        signed = _produce_with_pool(node0, 4)
+        assert len(signed.message.body.proposer_slashings) == 1
+        node0.publish_block(signed)
+        sim.drain()
+        # ...and the chain slashes the equivocator
+        head = node0.chain.head_state
+        assert head.validators[proposer].slashed
+        # both nodes converged on the slashing block
+        assert node1.chain.head_root == node0.chain.head_root
+
+    def test_duplicate_block_not_slashed(self):
+        """Re-gossip of the SAME block must never look like equivocation."""
+        sim = _sim_with_slasher()
+        node0, _ = sim.nodes
+        sim.run_slot(1)
+        parent = node0.chain._states[node0.chain.head_root]
+        sim.tick(2)
+        block, _ = sim.producer.produce_block(2, (), base_state=parent)
+        node0.publish_block(block)
+        # same block arrives again via gossip from a peer
+        node0._work_block((block, "peerX"))
+        sim.tick(3)
+        assert node0.slasher_service.proposer_slashings_found == 0
+
+
+class TestAttesterEquivocation:
+    def _indexed(self, sim, validator, target_epoch, root):
+        from lighthouse_tpu.types.containers import AttestationData, Checkpoint
+
+        t = types_for(MINIMAL)
+        return t.IndexedAttestation(
+            attesting_indices=[validator],
+            data=AttestationData(
+                slot=target_epoch * MINIMAL.slots_per_epoch,
+                index=0,
+                beacon_block_root=root,
+                source=Checkpoint(epoch=0, root=b"\x00" * 32),
+                target=Checkpoint(epoch=target_epoch, root=root),
+            ),
+            signature=INFINITY_SIGNATURE,
+        )
+
+    def test_double_vote_slashed_end_to_end(self):
+        sim = _sim_with_slasher()
+        node0, node1 = sim.nodes
+        for s in range(1, 5):
+            sim.run_slot(s)
+
+        v = 7
+        svc = node0.slasher_service
+        svc.accept_attestation(self._indexed(sim, v, 1, b"\xaa" * 32))
+        svc.accept_attestation(self._indexed(sim, v, 1, b"\xbb" * 32))
+        sim.tick(5)
+        assert svc.attester_slashings_found == 1
+        assert len(node0.op_pool._attester_slashings) == 1
+        # broadcast validated + pooled on the other node
+        assert len(node1.op_pool._attester_slashings) == 1
+
+        signed = _produce_with_pool(node0, 5)
+        assert len(signed.message.body.attester_slashings) == 1
+        node0.publish_block(signed)
+        sim.drain()
+        assert node0.chain.head_state.validators[v].slashed
+        assert node1.chain.head_root == node0.chain.head_root
+
+    def test_gossip_feed_reaches_slasher(self):
+        """Verified gossip attestations flow into the slasher queues."""
+        from lighthouse_tpu.state_transition import clone_state, process_slots
+
+        sim = _sim_with_slasher()
+        node0, node1 = sim.nodes
+        for s in range(1, 4):
+            sim.run_slot(s)
+        # unaggregated attestations over the subnet topics (node1 -> node0)
+        sim.tick(4)
+        parent = node1.chain._states[node1.chain.head_root]
+        adv = process_slots(clone_state(parent), 4, MINIMAL, sim.spec)
+        for att in sim.producer.attestations_for_slot(adv, 3):
+            # gossip carries UNAGGREGATED attestations: one bit each
+            bits = [False] * len(list(att.aggregation_bits))
+            bits[0] = True
+            single = type(att)(
+                aggregation_bits=bits,
+                data=att.data,
+                signature=att.signature,
+            )
+            node1.publish_attestation(single)
+        sim.drain()
+        assert node0.slasher_service.attestations_seen > 0
+        assert node0.slasher_service.blocks_seen > 0
+        # honest traffic produces no slashings
+        sim.tick(5)
+        assert node0.slasher_service.attester_slashings_found == 0
+        assert node0.slasher_service.proposer_slashings_found == 0
+
+
+class TestOpGossipValidation:
+    def test_bad_attester_slashing_penalized(self):
+        sim = _sim_with_slasher()
+        node0, node1 = sim.nodes
+        sim.run_slot(1)
+        t = types_for(MINIMAL)
+        # NOT slashable: different target epochs, no surround
+        a1 = TestAttesterEquivocation()._indexed(sim, 3, 1, b"\xaa" * 32)
+        a2 = TestAttesterEquivocation()._indexed(sim, 3, 2, b"\xbb" * 32)
+        bogus = t.AttesterSlashing(attestation_1=a1, attestation_2=a2)
+        node1._on_gossip_attester_slashing(bogus, "badpeer")
+        assert len(node1.op_pool._attester_slashings) == 0
+        assert node1.peer_scores.get("badpeer", 0) < 0

@@ -3,6 +3,16 @@ the JWT-authenticated HTTP transport, payload block-hash verification, and
 the in-process mock engine (reference beacon_node/execution_layer)."""
 
 from .auth import JwtError, JwtKey, generate_token, validate_token
+from .builder import (
+    BuilderError,
+    BuilderHttpClient,
+    BuilderHttpServer,
+    MockBuilder,
+    NoBidAvailable,
+    make_validator_registration,
+    unblind_signed_block,
+    verify_bid,
+)
 from .block_hash import (
     calculate_execution_block_hash,
     calculate_transactions_root,
@@ -26,8 +36,16 @@ from .http_engine import EngineRpcServer, HttpJsonRpcEngine
 from .mock_engine import MockExecutionEngine
 
 __all__ = [
+    "BuilderError",
+    "BuilderHttpClient",
+    "BuilderHttpServer",
     "EngineApiError",
     "EngineRpcServer",
+    "MockBuilder",
+    "NoBidAvailable",
+    "make_validator_registration",
+    "unblind_signed_block",
+    "verify_bid",
     "ExecutionEngine",
     "ExecutionLayer",
     "ForkchoiceState",

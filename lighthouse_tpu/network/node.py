@@ -116,8 +116,11 @@ class NetworkNode:
         bus.subscribe(
             peer_id, self._topic_voluntary_exit, self._on_gossip_voluntary_exit
         )
-        # dedup for op gossip (observed_operations.rs)
-        self._seen_ops: set[bytes] = set()
+        # dedup for op gossip (observed_operations.rs): insertion-ordered
+        # so the oldest half can be shed at the cap (the reference prunes
+        # at finalization; a lifetime-unbounded set is a slow leak)
+        self._seen_ops: dict[bytes, None] = {}
+        self._seen_ops_cap = 8192
         # optional slasher (slasher/service/src/lib.rs); attach_slasher wires it
         self.slasher_service = None
         for subnet in range(chain.preset.sync_committee_subnet_count):
@@ -192,7 +195,7 @@ class NetworkNode:
                 if kind == "attester_slashing"
                 else self._topic_proposer_slashing
             )
-            self._seen_ops.add(op.tree_hash_root())  # don't re-import our own
+            self._mark_op_seen(op.tree_hash_root())  # don't re-import our own
             self.bus.publish(self.peer_id, topic, op)
 
         self.slasher_service = SlasherService(slasher, self.op_pool, broadcast)
@@ -204,11 +207,17 @@ class NetworkNode:
 
     # -- operation gossip (verify_operation.rs + observed_operations.rs) ---
 
+    def _mark_op_seen(self, root: bytes) -> None:
+        self._seen_ops[root] = None
+        if len(self._seen_ops) > self._seen_ops_cap:
+            for old in list(self._seen_ops)[: self._seen_ops_cap // 2]:
+                del self._seen_ops[old]
+
     def _op_fresh(self, op) -> bool:
         root = op.tree_hash_root()
         if root in self._seen_ops:
             return False
-        self._seen_ops.add(root)
+        self._mark_op_seen(root)
         return True
 
     def _handle_op_gossip(self, op, source: str, validate, insert) -> None:
@@ -216,7 +225,8 @@ class NetworkNode:
         observe-after-verification pattern -- a transiently-unverifiable op
         must be retryable on re-gossip), and distinguish ignore (our view
         is behind: no penalty) from reject (provably bad: penalize)."""
-        if self.is_banned(source) or op.tree_hash_root() in self._seen_ops:
+        root = op.tree_hash_root()
+        if self.is_banned(source) or root in self._seen_ops:
             return
         from ..chain.pubkey_cache import PubkeyCacheError
 
@@ -227,7 +237,7 @@ class NetworkNode:
         except ValueError:
             self.penalize(source)
             return
-        self._seen_ops.add(op.tree_hash_root())
+        self._mark_op_seen(root)
         insert(op)
 
     def _on_gossip_proposer_slashing(self, slashing, source: str) -> None:

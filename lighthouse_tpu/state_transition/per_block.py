@@ -118,7 +118,7 @@ def per_block_processing(
     process_block_header(
         state, block, preset, spec, ctxt.get_proposer_index(state)
     )
-    if getattr(block.body, "execution_payload", None) is not None:
+    if body_payload(block.body) is not None:
         # spec order: process_execution_payload runs right after the header
         # (if_execution_enabled); randao is checked against the PRE-randao
         # mix, hence before process_randao
@@ -614,8 +614,18 @@ def _is_default_payload(payload) -> bool:
     return payload.tree_hash_root() == root
 
 
-def is_merge_transition_block(state, body) -> bool:
+def body_payload(body):
+    """The body's execution payload OR payload header (blinded blocks
+    carry the header only -- the reference's AbstractExecPayload seam over
+    FullPayload/BlindedPayload, consensus/types/src/payload.rs)."""
     payload = getattr(body, "execution_payload", None)
+    if payload is not None:
+        return payload
+    return getattr(body, "execution_payload_header", None)
+
+
+def is_merge_transition_block(state, body) -> bool:
+    payload = body_payload(body)
     if payload is None:
         return False
     return not is_merge_transition_complete(state) and not _is_default_payload(
@@ -625,8 +635,7 @@ def is_merge_transition_block(state, body) -> bool:
 
 def is_execution_enabled(state, body) -> bool:
     return is_merge_transition_block(state, body) or (
-        is_merge_transition_complete(state)
-        and getattr(body, "execution_payload", None) is not None
+        is_merge_transition_complete(state) and body_payload(body) is not None
     )
 
 
@@ -659,7 +668,8 @@ def process_execution_payload(
     from ..types import compute_epoch_at_slot as _epoch_at
     from ..types.helpers import get_randao_mix
 
-    payload = body.execution_payload
+    payload = body_payload(body)
+    blinded = not hasattr(payload, "transactions")
     if not is_execution_enabled(state, body):
         # pre-merge: payload must be the default one (tree-root compare:
         # SSZ offsets make even a default payload nonzero on the wire)
@@ -682,6 +692,16 @@ def process_execution_payload(
         state, state.slot, spec
     ):
         raise BlockProcessingError("payload timestamp mismatch")
+    if blinded:
+        # blinded processing: the header IS the commitment; there is no
+        # payload to send to an engine (the builder reveals it post-signing)
+        from ..types import types_for
+
+        t = types_for(preset)
+        state.latest_execution_payload_header = t.ExecutionPayloadHeader(
+            **{name: getattr(payload, name) for name, _ in payload.ssz_fields}
+        )
+        return
     if notify_new_payload is not None:
         ok = notify_new_payload(payload)
         if ok is False:

@@ -22,6 +22,11 @@ DOMAIN_AGGREGATE_AND_PROOF = (6).to_bytes(4, "little")
 DOMAIN_SYNC_COMMITTEE = (7).to_bytes(4, "little")
 DOMAIN_SYNC_COMMITTEE_SELECTION_PROOF = (8).to_bytes(4, "little")
 DOMAIN_CONTRIBUTION_AND_PROOF = (9).to_bytes(4, "little")
+# builder-network application domain (builder-specs; reference
+# consensus/types/src/chain_spec.rs DOMAIN_APPLICATION_MASK + builder).
+# Application domains use the genesis fork version and an empty
+# genesis_validators_root in compute_domain.
+DOMAIN_APPLICATION_BUILDER = bytes([0, 0, 0, 1])
 
 
 @dataclass

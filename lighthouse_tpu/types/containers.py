@@ -99,6 +99,24 @@ class DepositMessage:
 
 
 @container
+class ValidatorRegistrationV1:
+    """Builder-network validator registration (builder-specs; reference
+    consensus/types/src/validator_registration_data.rs), signed with the
+    application builder domain by the VC's preparation service."""
+
+    fee_recipient: Bytes20
+    gas_limit: uint64
+    timestamp: uint64
+    pubkey: Bytes48
+
+
+@container
+class SignedValidatorRegistration:
+    message: ValidatorRegistrationV1.ssz_type
+    signature: Bytes96
+
+
+@container
 class DepositData:
     pubkey: Bytes48
     withdrawal_credentials: Bytes32
@@ -359,6 +377,46 @@ def types_for(preset: Preset) -> SimpleNamespace:
         BeaconBlockBodyBellatrix, "bellatrix"
     )
 
+    # -- blinded blocks + builder bids (mev-boost flow; reference
+    # consensus/types/src/{blinded_payload.rs,builder_bid.rs} via the
+    # BeaconBlockBody superstruct's BlindedPayload variant) ----------------
+
+    @container
+    class BlindedBeaconBlockBody:
+        randao_reveal: Bytes96
+        eth1_data: Eth1Data.ssz_type
+        graffiti: Bytes32
+        proposer_slashings: List(
+            ProposerSlashing.ssz_type, preset.max_proposer_slashings
+        )
+        attester_slashings: List(
+            AttesterSlashing.ssz_type, preset.max_attester_slashings
+        )
+        attestations: List(Attestation.ssz_type, preset.max_attestations)
+        deposits: List(Deposit.ssz_type, preset.max_deposits)
+        voluntary_exits: List(
+            SignedVoluntaryExit.ssz_type, preset.max_voluntary_exits
+        )
+        sync_aggregate: SyncAggregate.ssz_type
+        execution_payload_header: ExecutionPayloadHeader.ssz_type
+
+    BlindedBeaconBlockBody.fork_name = "bellatrix"
+
+    BlindedBeaconBlock, SignedBlindedBeaconBlock = _block_classes(
+        BlindedBeaconBlockBody, "bellatrix"
+    )
+
+    @container
+    class BuilderBid:
+        header: ExecutionPayloadHeader.ssz_type
+        value: uint256
+        pubkey: Bytes48
+
+    @container
+    class SignedBuilderBid:
+        message: BuilderBid.ssz_type
+        signature: Bytes96
+
     _state_common = dict(
         genesis_time=uint64,
         genesis_validators_root=Bytes32,
@@ -459,6 +517,11 @@ def types_for(preset: Preset) -> SimpleNamespace:
         BeaconBlockBodyBellatrix=BeaconBlockBodyBellatrix,
         BeaconBlockBellatrix=BeaconBlockBellatrix,
         SignedBeaconBlockBellatrix=SignedBeaconBlockBellatrix,
+        BlindedBeaconBlockBody=BlindedBeaconBlockBody,
+        BlindedBeaconBlock=BlindedBeaconBlock,
+        SignedBlindedBeaconBlock=SignedBlindedBeaconBlock,
+        BuilderBid=BuilderBid,
+        SignedBuilderBid=SignedBuilderBid,
         BeaconState=BeaconState,
         BeaconStateAltair=BeaconStateAltair,
         BeaconStateBellatrix=BeaconStateBellatrix,

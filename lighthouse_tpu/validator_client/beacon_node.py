@@ -57,6 +57,9 @@ class InProcessBeaconNode:
         self.observed_sync_contributors = ObservedSyncContributors()
         self.observed_sync_aggregators = ObservedSyncAggregators()
         self.observed_contributions = ObservedAggregates()
+        # optional mev-boost builder handle (BuilderHttpClient); None =
+        # local payload production only
+        self.builder = None
         self.healthy = True  # toggled by tests to exercise VC failover
 
     # -- status --------------------------------------------------------------
@@ -85,6 +88,13 @@ class InProcessBeaconNode:
             for i, v in enumerate(state.validators)
             if bytes(v.pubkey) in wanted
         }
+
+    def register_validators(self, registrations) -> None:
+        """Forward VC builder registrations to the configured builder
+        (the reference BN's register_validator endpoint -> builder
+        fan-out); a builder-less BN accepts and drops them."""
+        if self.builder is not None:
+            self.builder.register_validators(registrations)
 
     def prepare_proposers(self, preparations) -> None:
         """Record proposer fee recipients with the execution layer
@@ -146,16 +156,10 @@ class InProcessBeaconNode:
 
     # -- block production/publish (block_service path) ----------------------
 
-    def produce_block(self, slot: int, randao_reveal: bytes, graffiti=b""):
-        """Unsigned block with pool-packed operations (the reference's
-        produce_block endpoint -> op_pool.get_attestations packing)."""
-        state = self.chain.state_for_block_production(slot)
-        fork = state.fork_name
+    def _pack_body(self, body, state, slot: int, randao_reveal, graffiti):
+        """Fill a (full or blinded) block body from the pools -- the one
+        packing path both production flavors share."""
         t = types_for(self.preset)
-        block_cls, signed_cls, body_cls = block_classes_for(t, fork)
-        proposer = get_beacon_proposer_index(state, self.preset, self.spec)
-
-        body = body_cls.default()
         body.randao_reveal = bytes(randao_reveal)
         body.eth1_data = state.eth1_data
         body.graffiti = bytes(graffiti).ljust(32, b"\x00")[:32]
@@ -172,6 +176,36 @@ class InProcessBeaconNode:
             body.sync_aggregate = self.sync_contribution_pool.get_sync_aggregate(
                 t, slot - 1, prev_root
             )
+        return body
+
+    def _fill_state_root(self, block, signed_cls, state, proposer: int):
+        """Scratch-apply the block to compute its post-state root."""
+        from ..crypto.bls import INFINITY_SIGNATURE
+
+        scratch = clone_state(state)
+        per_block_processing(
+            scratch,
+            signed_cls(message=block, signature=INFINITY_SIGNATURE),
+            self.preset,
+            self.spec,
+            strategy=BlockSignatureStrategy.NO_VERIFICATION,
+            verified_proposer_index=proposer,
+        )
+        block.state_root = cached_root(scratch)
+        return block
+
+    def produce_block(self, slot: int, randao_reveal: bytes, graffiti=b""):
+        """Unsigned block with pool-packed operations (the reference's
+        produce_block endpoint -> op_pool.get_attestations packing)."""
+        state = self.chain.state_for_block_production(slot)
+        fork = state.fork_name
+        t = types_for(self.preset)
+        block_cls, signed_cls, body_cls = block_classes_for(t, fork)
+        proposer = get_beacon_proposer_index(state, self.preset, self.spec)
+
+        body = self._pack_body(
+            body_cls.default(), state, slot, randao_reveal, graffiti
+        )
         el = self.chain.execution_layer
         if hasattr(body, "execution_payload") and el is not None:
             # payload build honors the proposer's prepared fee recipient
@@ -187,23 +221,64 @@ class InProcessBeaconNode:
             state_root=bytes(32),
             body=body,
         )
-        # state-root fill via scratch application
-        scratch = clone_state(state)
-        from ..crypto.bls import INFINITY_SIGNATURE
-
-        per_block_processing(
-            scratch,
-            signed_cls(message=block, signature=INFINITY_SIGNATURE),
-            self.preset,
-            self.spec,
-            strategy=BlockSignatureStrategy.NO_VERIFICATION,
-            verified_proposer_index=proposer,
-        )
-        block.state_root = cached_root(scratch)
-        return block
+        return self._fill_state_root(block, signed_cls, state, proposer)
 
     def publish_block(self, signed_block) -> bytes:
         return self.chain.process_block(signed_block)
+
+    # -- blinded production (mev-boost; execution_layer builder path) -------
+
+    def produce_blinded_block(self, slot: int, randao_reveal: bytes, graffiti=b""):
+        """A BLINDED block whose body carries the builder's
+        ExecutionPayloadHeader instead of a payload (builder_client flow,
+        beacon_node/execution_layer builder paths). Requires `self.builder`
+        (a BuilderHttpClient) and a registered proposer; raises
+        NoBidAvailable/BuilderError for the caller's local-production
+        fallback."""
+        from ..execution_layer.builder import BuilderError, verify_bid
+        from ..state_transition.per_block import is_merge_transition_complete
+
+        if getattr(self, "builder", None) is None:
+            raise BuilderError("no builder configured")
+        state = self.chain.state_for_block_production(slot)
+        if state.fork_name != "bellatrix":
+            raise BuilderError("blinded production is post-merge only")
+        t = types_for(self.preset)
+        proposer = get_beacon_proposer_index(state, self.preset, self.spec)
+        proposer_pubkey = bytes(state.validators[proposer].pubkey)
+
+        if is_merge_transition_complete(state):
+            parent_hash = bytes(state.latest_execution_payload_header.block_hash)
+        else:
+            parent_hash = self.chain.execution_layer.pre_merge_parent_hash
+        signed_bid = self.builder.get_header(slot, parent_hash, proposer_pubkey)
+        verify_bid(signed_bid, self.spec, parent_hash)
+
+        body = self._pack_body(
+            t.BlindedBeaconBlockBody.default(), state, slot, randao_reveal,
+            graffiti,
+        )
+        body.execution_payload_header = signed_bid.message.header
+
+        block = t.BlindedBeaconBlock(
+            slot=slot,
+            proposer_index=proposer,
+            parent_root=state.latest_block_header.tree_hash_root(),
+            state_root=bytes(32),
+            body=body,
+        )
+        return self._fill_state_root(
+            block, t.SignedBlindedBeaconBlock, state, proposer
+        )
+
+    def publish_blinded_block(self, signed_blinded) -> bytes:
+        """Submit to the builder, unblind the revealed payload, import +
+        return the full block root (publish_blocks.rs blinded path)."""
+        from ..execution_layer.builder import unblind_signed_block
+
+        payload = self.builder.submit_blinded_block(signed_blinded)
+        full = unblind_signed_block(signed_blinded, payload, self.preset)
+        return self.chain.process_block(full)
 
     # -- attestation endpoints ----------------------------------------------
 

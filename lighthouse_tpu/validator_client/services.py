@@ -128,6 +128,7 @@ class ValidatorClient:
         self.doppelganger_detected: list[bytes] = []
         self._dg_start: dict[bytes, int] = {}
         self._prepared_epochs: set[int] = set()
+        self._registered_epochs: set[int] = set()
 
     def _pubkey_for_index(self, index: int) -> bytes | None:
         for pk in self.store.voting_pubkeys():
@@ -142,6 +143,7 @@ class ValidatorClient:
         self.duties.poll(epoch)
         self._doppelganger_scan(epoch)
         self._preparation_duty(epoch)
+        self._builder_registrations(epoch)
         self._block_duty(slot)
         self._attestation_duty(slot)
         self._sync_committee_duty(slot)
@@ -178,6 +180,45 @@ class ValidatorClient:
             # short memory rather than growing forever
             self._prepared_epochs = {
                 e for e in self._prepared_epochs if e + 2 >= epoch
+            }
+
+    def _builder_registrations(self, epoch: int) -> None:
+        """Sign + fan out builder-network registrations for every
+        validator with a fee recipient (preparation_service.rs's
+        register_validators leg). Independent of proposer preparations:
+        registrations need no validator index, and are retried within the
+        epoch until at least one builder-capable BN takes them."""
+        if epoch in self._registered_epochs:
+            return
+        timestamp = (
+            epoch
+            * self.preset.slots_per_epoch
+            * self.store.spec.seconds_per_slot
+        )
+        regs = []
+        for pk in self.store.voting_pubkeys():
+            fee = self.store.fee_recipient_for(pk)
+            if fee is None:
+                continue
+            try:
+                regs.append(
+                    self.store.sign_validator_registration(
+                        pk, fee, 30_000_000, timestamp
+                    )
+                )
+            except Exception:  # noqa: BLE001 -- doppelganger hold etc.
+                continue
+        if not regs:
+            return
+        pushed = False
+        for node in self.nodes.candidates:
+            if node.is_healthy() and hasattr(node, "register_validators"):
+                node.register_validators(regs)
+                pushed = True
+        if pushed:
+            self._registered_epochs.add(epoch)
+            self._registered_epochs = {
+                e for e in self._registered_epochs if e + 2 >= epoch
             }
 
     def _block_duty(self, slot: int) -> None:

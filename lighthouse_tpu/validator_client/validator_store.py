@@ -122,6 +122,29 @@ class ValidatorStore:
 
     # -- signing (validator_store.rs sign_*) --------------------------------
 
+    def sign_validator_registration(
+        self, pubkey: bytes, fee_recipient: bytes, gas_limit: int, timestamp: int
+    ):
+        """Builder-network registration (validator_store.rs
+        sign_validator_registration): application-builder domain, no
+        slashing-DB interaction (registrations are not consensus
+        messages)."""
+        from ..execution_layer.builder import builder_signing_root
+        from ..types.containers import (
+            SignedValidatorRegistration,
+            ValidatorRegistrationV1,
+        )
+
+        method = self._method(pubkey)
+        msg = ValidatorRegistrationV1(
+            fee_recipient=bytes(fee_recipient),
+            gas_limit=gas_limit,
+            timestamp=timestamp,
+            pubkey=bytes(pubkey),
+        )
+        sig = method.sign(builder_signing_root(msg, self.spec))
+        return SignedValidatorRegistration(message=msg, signature=sig.to_bytes())
+
     def sign_block(self, pubkey: bytes, block, state) -> Signature:
         # resolve the method FIRST: a doppelganger hold must not burn the
         # slot in the slashing DB for a signature that is never produced

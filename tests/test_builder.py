@@ -1,0 +1,216 @@
+"""mev-boost builder flow (VERDICT r3 item 5; reference
+builder_client/src/lib.rs + execution_layer builder paths +
+test_utils/mock_builder.rs): registration fan-out, header bids over the
+builder REST surface, blinded production, and unblinding -- with the
+builder fault cases (refuse-to-reveal, corrupted header, no bid)."""
+
+import pytest
+
+from lighthouse_tpu.crypto.bls import INFINITY_SIGNATURE, SecretKey, set_backend
+from lighthouse_tpu.execution_layer import (
+    BuilderError,
+    BuilderHttpClient,
+    BuilderHttpServer,
+    ExecutionLayer,
+    MockBuilder,
+    MockExecutionEngine,
+    NoBidAvailable,
+    make_validator_registration,
+    unblind_signed_block,
+    verify_bid,
+)
+from lighthouse_tpu.harness import BeaconChainHarness
+from lighthouse_tpu.types import ChainSpec, MINIMAL, types_for
+from lighthouse_tpu.validator_client.beacon_node import InProcessBeaconNode
+
+
+@pytest.fixture(autouse=True)
+def fake_crypto():
+    set_backend("fake")
+    yield
+    set_backend("jax_tpu")
+
+
+def _bellatrix_rig(validators=16):
+    """Harness chain crossed into bellatrix + a mock builder behind HTTP."""
+    t = types_for(MINIMAL)
+    engine = MockExecutionEngine(t)
+    el = ExecutionLayer(engine)
+    spec = ChainSpec.interop(altair_fork_epoch=1, bellatrix_fork_epoch=2)
+    h = BeaconChainHarness(validators, MINIMAL, spec, sign=False, execution_layer=el)
+    h.extend_chain(3 * MINIMAL.slots_per_epoch)
+    assert h.chain.head_state.fork_name == "bellatrix"
+    builder = MockBuilder(el, MINIMAL, spec, chain=h.chain)
+    server = BuilderHttpServer(builder).start()
+    client = BuilderHttpClient(server.url, MINIMAL)
+    return h, builder, server, client, spec
+
+
+def _register_all(h, client, spec, n):
+    regs = [
+        make_validator_registration(
+            __import__(
+                "lighthouse_tpu.types.interop", fromlist=["interop_secret_key"]
+            ).interop_secret_key(i),
+            b"\xfe" * 20,
+            30_000_000,
+            1234,
+            spec,
+        )
+        for i in range(n)
+    ]
+    client.register_validators(regs)
+
+
+class TestRegistration:
+    def test_registration_round_trips_over_http(self):
+        h, builder, server, client, spec = _bellatrix_rig()
+        try:
+            _register_all(h, client, spec, 4)
+            assert len(builder.registrations) == 4
+            reg = next(iter(builder.registrations.values()))
+            assert bytes(reg.message.fee_recipient) == b"\xfe" * 20
+        finally:
+            server.stop()
+
+    def test_vc_service_fans_out_registrations(self):
+        from lighthouse_tpu.validator_client.validator_store import (
+            LocalKeystore,
+            ValidatorStore,
+        )
+        from lighthouse_tpu.types.interop import interop_secret_key
+
+        spec = ChainSpec.interop()
+        store = ValidatorStore(MINIMAL, spec)
+        sk = interop_secret_key(0)
+        store.add_validator(LocalKeystore(sk))
+        store.set_fee_recipient(sk.public_key().to_bytes(), b"\xaa" * 20)
+        signed = store.sign_validator_registration(
+            sk.public_key().to_bytes(), b"\xaa" * 20, 30_000_000, 99
+        )
+        assert bytes(signed.message.pubkey) == sk.public_key().to_bytes()
+        assert int(signed.message.timestamp) == 99
+
+
+class TestBlindedFlow:
+    def test_blinded_block_produced_and_unblinded(self):
+        h, builder, server, client, spec = _bellatrix_rig()
+        try:
+            _register_all(h, client, spec, 16)
+            bn = InProcessBeaconNode(h.chain)
+            bn.builder = client
+            slot = h.chain.head_state.slot + 1
+            h.chain.slot_clock.set_slot(slot)
+            blinded = bn.produce_blinded_block(slot, INFINITY_SIGNATURE)
+            # body commits to the builder's header, not a payload
+            assert hasattr(blinded.body, "execution_payload_header")
+            t = types_for(MINIMAL)
+            signed = t.SignedBlindedBeaconBlock(
+                message=blinded, signature=INFINITY_SIGNATURE
+            )
+            root = bn.publish_blinded_block(signed)
+            assert h.chain.head_root == root
+            # the chain's header matches what the builder bid
+            hdr = h.chain.head_state.latest_execution_payload_header
+            assert int(hdr.block_number) > 0
+        finally:
+            server.stop()
+
+    def test_refuse_reveal_blocks_import(self):
+        h, builder, server, client, spec = _bellatrix_rig()
+        try:
+            _register_all(h, client, spec, 16)
+            bn = InProcessBeaconNode(h.chain)
+            bn.builder = client
+            slot = h.chain.head_state.slot + 1
+            h.chain.slot_clock.set_slot(slot)
+            blinded = bn.produce_blinded_block(slot, INFINITY_SIGNATURE)
+            t = types_for(MINIMAL)
+            signed = t.SignedBlindedBeaconBlock(
+                message=blinded, signature=INFINITY_SIGNATURE
+            )
+            head_before = h.chain.head_root
+            builder.refuse_reveal = True
+            with pytest.raises(BuilderError):
+                bn.publish_blinded_block(signed)
+            assert h.chain.head_root == head_before  # nothing imported
+        finally:
+            server.stop()
+
+    def test_corrupt_header_rejected_at_unblind(self):
+        h, builder, server, client, spec = _bellatrix_rig()
+        try:
+            _register_all(h, client, spec, 16)
+            builder.corrupt_header = True
+            bn = InProcessBeaconNode(h.chain)
+            bn.builder = client
+            slot = h.chain.head_state.slot + 1
+            h.chain.slot_clock.set_slot(slot)
+            blinded = bn.produce_blinded_block(slot, INFINITY_SIGNATURE)
+            t = types_for(MINIMAL)
+            signed = t.SignedBlindedBeaconBlock(
+                message=blinded, signature=INFINITY_SIGNATURE
+            )
+            with pytest.raises(BuilderError, match="does not match"):
+                bn.publish_blinded_block(signed)
+        finally:
+            server.stop()
+
+    def test_no_bid_surfaces_for_local_fallback(self):
+        h, builder, server, client, spec = _bellatrix_rig()
+        try:
+            _register_all(h, client, spec, 16)
+            builder.no_bid = True
+            bn = InProcessBeaconNode(h.chain)
+            bn.builder = client
+            slot = h.chain.head_state.slot + 1
+            h.chain.slot_clock.set_slot(slot)
+            with pytest.raises(NoBidAvailable):
+                bn.produce_blinded_block(slot, INFINITY_SIGNATURE)
+            # the local-production path still works as the fallback
+            block = bn.produce_block(slot, INFINITY_SIGNATURE)
+            assert int(block.slot) == slot
+        finally:
+            server.stop()
+
+    def test_unregistered_proposer_gets_no_bid(self):
+        h, builder, server, client, spec = _bellatrix_rig()
+        try:
+            bn = InProcessBeaconNode(h.chain)
+            bn.builder = client
+            slot = h.chain.head_state.slot + 1
+            h.chain.slot_clock.set_slot(slot)
+            with pytest.raises(NoBidAvailable):
+                bn.produce_blinded_block(slot, INFINITY_SIGNATURE)
+        finally:
+            server.stop()
+
+
+class TestBidVerification:
+    def test_real_bid_signature_verifies_and_tamper_fails(self):
+        """The builder's bid signature checked with REAL pairing math
+        (cpu oracle backend): genuine bid passes, tampered value fails."""
+        set_backend("cpu")
+        try:
+            t = types_for(MINIMAL)
+            engine = MockExecutionEngine(t)
+            el = ExecutionLayer(engine)
+            spec = ChainSpec.interop()
+            builder = MockBuilder(el, MINIMAL, spec, secret_key=SecretKey(7))
+            sk = SecretKey(11)
+            builder.register_validators(
+                [
+                    make_validator_registration(
+                        sk, b"\xaa" * 20, 30_000_000, 5, spec
+                    )
+                ]
+            )
+            bid = builder.get_header(
+                1, engine.genesis_hash, sk.public_key().to_bytes()
+            )
+            verify_bid(bid, spec, engine.genesis_hash)
+            bid.message.value = int(bid.message.value) + 1  # sweeten the pot
+            with pytest.raises(BuilderError, match="signature"):
+                verify_bid(bid, spec, engine.genesis_hash)
+        finally:
+            set_backend("fake")

@@ -1,0 +1,205 @@
+"""Local (CPU-only) performance floor: numbers rounds can diff even when
+the TPU tunnel is down (VERDICT r3 weak-8). Writes BENCH_LOCAL.json at
+the repo root with one entry per config from BASELINE.md:
+
+  * verifier_mesh_sets_per_s -- the sharded batch verifier on the
+    8-virtual-device CPU mesh (BASELINE config 5's local stand-in)
+  * epoch_transition_s       -- process_slots across an epoch boundary
+    on a synthetic N-validator state (BASELINE config 4)
+  * cached_tree_hash_speedup -- steady-state re-root vs from-scratch
+    merkleization at N validators (reference criterion benches)
+  * op_pool_pack_s           -- max-cover packing over 4,096 pooled
+    aggregates (BASELINE config 2/3)
+
+Sizes shrink via BENCH_LOCAL_SCALE=mini for the in-suite smoke test.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import random
+import sys
+import time
+
+HERE = os.path.dirname(os.path.abspath(__file__))
+sys.path.insert(0, HERE)
+
+
+def _force_cpu():
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+    try:
+        jax.config.update("jax_num_cpu_devices", 8)
+    except RuntimeError:
+        pass
+    from __graft_entry__ import _arm_compilation_cache
+
+    _arm_compilation_cache()
+
+
+def bench_verifier_mesh(n_sets: int = 8) -> dict:
+    """Sharded verify on the 8-device CPU mesh, warm, one set/device."""
+    import jax
+    from jax.sharding import NamedSharding, PartitionSpec
+
+    from __graft_entry__ import _example_batch
+    from lighthouse_tpu.parallel import make_sharded_verify, sets_mesh
+
+    devices = jax.devices("cpu")[:8]
+    mesh = sets_mesh(devices)
+    fn = make_sharded_verify(mesh)
+    args = _example_batch(n_sets=n_sets, k_pk=2, distinct=min(n_sets, 8))
+    sharding = NamedSharding(mesh, PartitionSpec("sets"))
+    args = tuple(jax.device_put(a, sharding) for a in args)
+    t0 = time.perf_counter()
+    ok = bool(fn(*args))  # compile (cached) + run
+    compile_s = time.perf_counter() - t0
+    assert ok
+    times = []
+    for _ in range(2):
+        t0 = time.perf_counter()
+        bool(fn(*args))
+        times.append(time.perf_counter() - t0)
+    best = min(times)
+    return {
+        "metric": "verifier_mesh_sets_per_s",
+        "value": round(n_sets / best, 2),
+        "n_sets": n_sets,
+        "n_devices": 8,
+        "compile_s": round(compile_s, 2),
+    }
+
+
+def _synthetic_state(n_validators: int):
+    from lighthouse_tpu.types import MINIMAL, types_for
+    from lighthouse_tpu.types.chain_spec import FAR_FUTURE_EPOCH
+    from lighthouse_tpu.types.containers import Validator
+
+    t = types_for(MINIMAL)
+    state = t.BeaconState.default()
+    rng = random.Random(7)
+    state.validators = tuple(
+        Validator(
+            pubkey=rng.randbytes(48),
+            withdrawal_credentials=rng.randbytes(32),
+            effective_balance=32 * 10**9,
+            exit_epoch=FAR_FUTURE_EPOCH,
+            withdrawable_epoch=FAR_FUTURE_EPOCH,
+        )
+        for _ in range(n_validators)
+    )
+    state.balances = tuple(32 * 10**9 for _ in range(n_validators))
+    return state
+
+
+def bench_epoch_transition(n_validators: int = 100_000) -> dict:
+    from lighthouse_tpu.state_transition import process_slots
+    from lighthouse_tpu.types import MINIMAL, ChainSpec
+
+    spec = ChainSpec.interop(altair_fork_epoch=None, bellatrix_fork_epoch=None)
+    state = _synthetic_state(n_validators)
+    state.slot = MINIMAL.slots_per_epoch - 1
+    t0 = time.perf_counter()
+    process_slots(state, MINIMAL.slots_per_epoch + 1, MINIMAL, spec)
+    dt = time.perf_counter() - t0
+    return {
+        "metric": "epoch_transition_s",
+        "value": round(dt, 3),
+        "n_validators": n_validators,
+    }
+
+
+def bench_cached_tree_hash(n_validators: int = 16_384) -> dict:
+    from lighthouse_tpu.ssz import cached_root
+
+    state = _synthetic_state(n_validators)
+    t0 = time.perf_counter()
+    fresh = state.tree_hash_root()
+    fresh_s = time.perf_counter() - t0
+    assert cached_root(state) == fresh  # cold cache build
+    bal = list(state.balances)
+    for i in random.Random(1).sample(range(n_validators), 10):
+        bal[i] += 1
+    state.balances = tuple(bal)
+    t0 = time.perf_counter()
+    cached_root(state)
+    cached_s = time.perf_counter() - t0
+    return {
+        "metric": "cached_tree_hash_speedup",
+        "value": round(fresh_s / max(cached_s, 1e-9), 1),
+        "fresh_s": round(fresh_s, 3),
+        "cached_s": round(cached_s, 5),
+        "n_validators": n_validators,
+    }
+
+
+def bench_op_pool_pack(n_attestations: int = 4096, validators: int = 256) -> dict:
+    from lighthouse_tpu.harness.chain import StateHarness
+    from lighthouse_tpu.pool import OperationPool
+    from lighthouse_tpu.state_transition import clone_state, process_slots
+    from lighthouse_tpu.state_transition.context import ConsensusContext
+    from lighthouse_tpu.types import MINIMAL, types_for
+
+    h = StateHarness(validators, MINIMAL, sign=False)
+    t = types_for(MINIMAL)
+    target_slot = 2 * MINIMAL.slots_per_epoch
+    state = process_slots(
+        clone_state(h.state), target_slot, MINIMAL, h.spec
+    )
+    pool = OperationPool(MINIMAL, h.spec)
+    rng = random.Random(3)
+    ctxt = ConsensusContext(MINIMAL, h.spec)
+    # fill until the pool RETAINS n_attestations distinct aggregates
+    # (subset variants are deduped on insert), with an attempt cap
+    attempts = 0
+    while pool.num_attestations() < n_attestations and attempts < 20 * n_attestations:
+        slot = rng.randrange(state.slot - MINIMAL.slots_per_epoch + 1, state.slot)
+        for att in h.attestations_for_slot(state, slot):
+            bits = [rng.random() < 0.5 for _ in att.aggregation_bits]
+            if not any(bits):
+                bits[0] = True
+            pool.insert_attestation(
+                t.Attestation(
+                    aggregation_bits=bits,
+                    data=att.data,
+                    signature=att.signature,
+                )
+            )
+            attempts += 1
+            if pool.num_attestations() >= n_attestations:
+                break
+    t0 = time.perf_counter()
+    packed = pool.get_attestations(state)
+    dt = time.perf_counter() - t0
+    return {
+        "metric": "op_pool_pack_s",
+        "value": round(dt, 3),
+        "pooled": pool.num_attestations(),
+        "packed": len(packed),
+    }
+
+
+def main() -> None:
+    mini = os.environ.get("BENCH_LOCAL_SCALE") == "mini"
+    _force_cpu()
+    results = [
+        bench_verifier_mesh(8),
+        bench_epoch_transition(2_000 if mini else 100_000),
+        bench_cached_tree_hash(2_048 if mini else 16_384),
+        bench_op_pool_pack(256 if mini else 4096, 64 if mini else 256),
+    ]
+    payload = {
+        "scale": "mini" if mini else "full",
+        "platform": "cpu",
+        "results": results,
+    }
+    out = os.path.join(HERE, "BENCH_LOCAL.json")
+    with open(out, "w") as f:
+        json.dump(payload, f, indent=1)
+    print(json.dumps(payload))
+
+
+if __name__ == "__main__":
+    main()

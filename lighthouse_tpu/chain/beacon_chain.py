@@ -244,6 +244,10 @@ class BeaconChain:
 
         self.on_tick()
         block = signed_block.message
+        if block.slot > self.current_slot:
+            # checked BEFORE any transition/store work: fork choice would
+            # reject it anyway, but only after a partial import
+            raise BlockError("block from the future")
         block_root = block.tree_hash_root()
         if block_root in self._states:
             return block_root, False  # duplicate import

@@ -292,11 +292,21 @@ class BuilderHttpClient:
     """The BN's builder handle (builder_client/src/lib.rs): REST verbs
     with SSZ bodies, bounded timeout, 204 -> NoBidAvailable."""
 
-    def __init__(self, url: str, preset, timeout_s: float = 5.0):
+    def __init__(
+        self,
+        url: str,
+        preset,
+        timeout_s: float = 5.0,
+        trusted_pubkey: bytes | None = None,
+    ):
         self.url = url.rstrip("/")
         self.preset = preset
         self.t = types_for(preset)
         self.timeout_s = timeout_s
+        # the configured builder's BLS identity (verify_bid pins bids to it)
+        self.trusted_pubkey = (
+            bytes(trusted_pubkey) if trusted_pubkey is not None else None
+        )
 
     def _request(self, method: str, path: str, body: bytes | None = None):
         req = urllib.request.Request(
@@ -336,16 +346,25 @@ class BuilderHttpClient:
         return self.t.ExecutionPayload.from_ssz_bytes(body)
 
 
-def verify_bid(signed_bid, spec, expected_parent_hash: bytes) -> None:
+def verify_bid(
+    signed_bid,
+    spec,
+    expected_parent_hash: bytes,
+    trusted_pubkey: bytes | None = None,
+) -> None:
     """The BN-side bid checks (execution_layer/src/lib.rs builder path):
-    the bid's header must build on the right parent, and the builder's
-    signature over the bid must verify against the bid's own pubkey."""
+    the bid's header must build on the right parent and the signature must
+    verify. `trusted_pubkey` pins the CONFIGURED builder identity -- a bid
+    self-signed under an attacker's fresh key must not pass, or a relay
+    can burn the proposer's slot with a header nobody will reveal."""
     from ..crypto.bls import PublicKey, Signature, verify_signature_sets
     from ..crypto.bls.api import SignatureSet
 
     bid = signed_bid.message
     if bytes(bid.header.parent_hash) != bytes(expected_parent_hash):
         raise BuilderError("bid builds on the wrong parent")
+    if trusted_pubkey is not None and bytes(bid.pubkey) != bytes(trusted_pubkey):
+        raise BuilderError("bid signed by an unexpected builder key")
     root = builder_signing_root(bid, spec)
     pk = PublicKey.from_bytes(bytes(bid.pubkey))
     sig = Signature.from_bytes(bytes(signed_bid.signature))

@@ -45,6 +45,12 @@ class ForkChoice:
         self.spec = spec
         self.justified_checkpoint = justified_checkpoint
         self.finalized_checkpoint = finalized_checkpoint
+        # unrealized store checkpoints (fork_choice.rs unrealized_justified/
+        # finalized_checkpoint): the best justification any imported state
+        # COULD realize at its next epoch boundary; pulled into the
+        # realized checkpoints at the boundary tick
+        self.unrealized_justified_checkpoint = justified_checkpoint
+        self.unrealized_finalized_checkpoint = finalized_checkpoint
         self.justified_balances: list[int] = []
         # root -> post-state resolver for the justified checkpoint's state
         # (reference: JustifiedBalances built from the justified state,
@@ -59,6 +65,7 @@ class ForkChoice:
             genesis_root,
             justified_checkpoint,
             finalized_checkpoint,
+            slots_per_epoch=preset.slots_per_epoch,
         )
 
     # -- time (fork_choice.rs on_tick) --------------------------------------
@@ -69,6 +76,32 @@ class ForkChoice:
             self._dequeue_attestations()
             # proposer boost expires at the start of the next slot
             self.proto.proposer_boost_root = None
+            # epoch-boundary pull-up (fork_choice.rs on_tick): what was
+            # unrealized last epoch is realized now, even if no block has
+            # imported since -- the late-epoch justification race
+            if self.current_slot % self.preset.slots_per_epoch == 0:
+                self._realize_unrealized()
+
+    def _realize_unrealized(self) -> None:
+        if (
+            self.unrealized_justified_checkpoint[0]
+            > self.justified_checkpoint[0]
+        ):
+            self.justified_checkpoint = self.unrealized_justified_checkpoint
+            state = (
+                self.state_lookup(self.justified_checkpoint[1])
+                if self.state_lookup
+                else None
+            )
+            if state is not None:
+                self.justified_balances = _justified_balances(
+                    state, self.preset, self.justified_checkpoint[0]
+                )
+        if (
+            self.unrealized_finalized_checkpoint[0]
+            > self.finalized_checkpoint[0]
+        ):
+            self.finalized_checkpoint = self.unrealized_finalized_checkpoint
 
     def _dequeue_attestations(self) -> None:
         remaining = []
@@ -89,11 +122,16 @@ class ForkChoice:
         execution_status: str = "irrelevant",
         execution_block_hash: bytes = b"",
     ) -> None:
-        """`state` is the post-state of the block: its justified/finalized
-        checkpoints feed the store (the reference's unrealized-justification
-        machinery reduces to this under per-block epoch processing).
+        """`state` is the post-state of the block. Realized checkpoints
+        feed the store; the UNREALIZED pair (what the state would justify
+        at its next boundary) feeds the store's unrealized checkpoints and
+        -- for blocks from prior epochs -- the node itself
+        (fork_choice.rs:747 on_block + compute_unrealized_checkpoints).
         `execution_status` carries the engine verdict for optimistic-sync
-        tracking (fork_choice.rs:747's payload_verification_status)."""
+        tracking."""
+        from ..state_transition.per_epoch import compute_unrealized_checkpoints
+        from ..types import compute_epoch_at_slot as _epoch_at
+
         block = signed_block.message
         if block.slot > self.current_slot:
             raise ForkChoiceError("block from the future")
@@ -105,19 +143,36 @@ class ForkChoice:
             state.finalized_checkpoint.epoch,
             bytes(state.finalized_checkpoint.root),
         )
-        if jc[0] > self.justified_checkpoint[0]:
-            self.justified_checkpoint = jc
-            self.justified_balances = self._balances_for_checkpoint(jc, state)
-        if fc[0] > self.finalized_checkpoint[0]:
-            self.finalized_checkpoint = fc
+        ujc, ufc = compute_unrealized_checkpoints(state, self.preset, self.spec)
+        if ujc[0] > self.unrealized_justified_checkpoint[0]:
+            self.unrealized_justified_checkpoint = ujc
+        if ufc[0] > self.unrealized_finalized_checkpoint[0]:
+            self.unrealized_finalized_checkpoint = ufc
+
+        block_epoch = _epoch_at(block.slot, self.preset)
+        current_epoch = _epoch_at(self.current_slot, self.preset)
+        node_jc, node_fc = jc, fc
+        if block_epoch < current_epoch:
+            # a prior-epoch block: from our perspective its epoch boundary
+            # has passed, so its unrealized checkpoints are realized
+            node_jc, node_fc = ujc, ufc
+
+        if node_jc[0] > self.justified_checkpoint[0]:
+            self.justified_checkpoint = node_jc
+            self.justified_balances = self._balances_for_checkpoint(
+                node_jc, state
+            )
+        if node_fc[0] > self.finalized_checkpoint[0]:
+            self.finalized_checkpoint = node_fc
         self.proto.process_block(
             block.slot,
             block_root,
             bytes(block.parent_root),
-            jc,
-            fc,
+            node_jc,
+            node_fc,
             execution_status,
             execution_block_hash,
+            unrealized_justified_checkpoint=ujc,
         )
         # proposer boost: only the FIRST timely block of the slot gets it
         # (spec: set only when proposer_boost_root is empty)
@@ -181,6 +236,9 @@ class ForkChoice:
                 self.finalized_checkpoint,
                 self.justified_balances,
                 boost,
+                current_epoch=compute_epoch_at_slot(
+                    self.current_slot, self.preset
+                ),
             )
         except ProtoArrayError as e:
             raise ForkChoiceError(str(e)) from None

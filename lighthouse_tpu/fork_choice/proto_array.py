@@ -23,6 +23,10 @@ class ProtoNode:
     parent: int | None
     justified_checkpoint: tuple[int, bytes]
     finalized_checkpoint: tuple[int, bytes]
+    # what this block's state WOULD justify at its next epoch boundary
+    # (fork_choice.rs unrealized_justifications; the voting source for
+    # nodes from prior epochs, spec get_voting_source)
+    unrealized_justified_checkpoint: tuple[int, bytes] | None = None
     weight: int = 0
     best_child: int | None = None
     best_descendant: int | None = None
@@ -47,12 +51,18 @@ class ProtoArray:
         self,
         justified_checkpoint: tuple[int, bytes],
         finalized_checkpoint: tuple[int, bytes],
+        slots_per_epoch: int | None = None,
     ):
         self.nodes: list[ProtoNode] = []
         self.indices: dict[bytes, int] = {}
         self.justified_checkpoint = justified_checkpoint
         self.finalized_checkpoint = finalized_checkpoint
+        self.current_epoch: int | None = None
+        self.slots_per_epoch = slots_per_epoch
         self.prune_threshold = 256
+        # finalized-ancestry memo (see _descends_from)
+        self._descent_cache: dict[bytes, bool] = {}
+        self._descent_cache_root: bytes | None = None
 
     # -- insertion (proto_array.rs on_block) --------------------------------
 
@@ -65,6 +75,7 @@ class ProtoArray:
         finalized_checkpoint: tuple[int, bytes],
         execution_status: str = "irrelevant",
         execution_block_hash: bytes = b"",
+        unrealized_justified_checkpoint: tuple[int, bytes] | None = None,
     ) -> None:
         if root in self.indices:
             return
@@ -75,6 +86,7 @@ class ProtoArray:
             parent=parent,
             justified_checkpoint=justified_checkpoint,
             finalized_checkpoint=finalized_checkpoint,
+            unrealized_justified_checkpoint=unrealized_justified_checkpoint,
             execution_status=execution_status,
             execution_block_hash=bytes(execution_block_hash),
         )
@@ -93,11 +105,13 @@ class ProtoArray:
         finalized_checkpoint: tuple[int, bytes],
         proposer_boost_root: bytes | None = None,
         proposer_boost_amount: int = 0,
+        current_epoch: int | None = None,
     ) -> None:
         if len(deltas) != len(self.nodes):
             raise ProtoArrayError("deltas length != nodes length")
         self.justified_checkpoint = justified_checkpoint
         self.finalized_checkpoint = finalized_checkpoint
+        self.current_epoch = current_epoch
 
         # proposer boost enters as an extra (transient) delta each run:
         # previous boost is subtracted by the caller via deltas
@@ -125,7 +139,13 @@ class ProtoArray:
     def find_head(self, justified_root: bytes) -> bytes:
         idx = self.indices.get(justified_root)
         if idx is None:
-            raise ProtoArrayError("justified root unknown to proto array")
+            # checkpoint-synced view: the justified block can predate the
+            # anchor (unrealized pull-up references pre-anchor epoch
+            # roots). Every node descends from it THROUGH the anchor, so
+            # the array root yields the same head.
+            if not self.nodes:
+                raise ProtoArrayError("justified root unknown to proto array")
+            idx = 0
         node = self.nodes[idx]
         best = (
             self.nodes[node.best_descendant]
@@ -194,20 +214,66 @@ class ProtoArray:
             make_best()
 
     def _node_is_viable_for_head(self, node: ProtoNode) -> bool:
-        """proto_array.rs node_is_viable_for_head: the node must agree with
-        the store's justified and finalized checkpoints (epoch 0 wildcards
-        accepted, matching genesis bootstrapping)."""
+        """Spec filter_block_tree viability: the node's voting source must
+        match the store's justified checkpoint OR be recent (within 2
+        epochs of current -- the unrealized-justification tolerance that
+        keeps late-epoch nodes viable across the boundary pull-up), and
+        the store's finalized checkpoint must be the node's own or an
+        ancestor (epoch 0 wildcards accepted at genesis bootstrap)."""
         if node.execution_status == "invalid":
             return False
+        # spec get_voting_source: a node from a PRIOR epoch votes with its
+        # unrealized justification (its own boundary has passed)
+        voting_source = node.justified_checkpoint
+        if (
+            self.current_epoch is not None
+            and self.slots_per_epoch
+            and node.slot // self.slots_per_epoch < self.current_epoch
+            and node.unrealized_justified_checkpoint is not None
+        ):
+            voting_source = node.unrealized_justified_checkpoint
         j_ok = (
-            node.justified_checkpoint == self.justified_checkpoint
-            or self.justified_checkpoint[0] == 0
+            self.justified_checkpoint[0] == 0
+            or voting_source == self.justified_checkpoint
+            or (
+                self.current_epoch is not None
+                and voting_source[0] + 2 >= self.current_epoch
+            )
         )
         f_ok = (
-            node.finalized_checkpoint == self.finalized_checkpoint
-            or self.finalized_checkpoint[0] == 0
+            self.finalized_checkpoint[0] == 0
+            or node.finalized_checkpoint == self.finalized_checkpoint
+            or self._descends_from(node, self.finalized_checkpoint[1])
         )
         return j_ok and f_ok
+
+    def _descends_from(self, node: ProtoNode, root: bytes) -> bool:
+        """Memoized ancestry walk: the finalized-ancestry viability check
+        runs per node per score sweep, so an uncached walk would make head
+        recomputation O(n * depth). The cache is keyed to the finalized
+        root and fills with path compression (every node on a walked path
+        gets its verdict recorded)."""
+        if self._descent_cache_root != root:
+            self._descent_cache_root = root
+            self._descent_cache = {}
+        cache = self._descent_cache
+        path = []
+        idx = self.indices.get(node.root)
+        verdict = False
+        while idx is not None:
+            n = self.nodes[idx]
+            hit = cache.get(n.root)
+            if hit is not None:
+                verdict = hit
+                break
+            if n.root == root:
+                verdict = True
+                break
+            path.append(n.root)
+            idx = n.parent
+        for r in path:
+            cache[r] = verdict
+        return verdict
 
     # -- optimistic-sync invalidation (proto_array.rs ExecutionStatus
     #    propagation; reference fork_choice.rs on_invalid_execution_payload) --
@@ -318,9 +384,10 @@ class ProtoArrayForkChoice:
         finalized_root: bytes,
         justified_checkpoint: tuple[int, bytes],
         finalized_checkpoint: tuple[int, bytes],
+        slots_per_epoch: int | None = None,
     ):
         self.proto_array = ProtoArray(
-            justified_checkpoint, finalized_checkpoint
+            justified_checkpoint, finalized_checkpoint, slots_per_epoch
         )
         self.votes: dict[int, VoteTracker] = {}
         self.balances: list[int] = []
@@ -343,6 +410,7 @@ class ProtoArrayForkChoice:
         finalized_checkpoint,
         execution_status: str = "irrelevant",
         execution_block_hash: bytes = b"",
+        unrealized_justified_checkpoint=None,
     ):
         self.proto_array.on_block(
             slot,
@@ -352,6 +420,7 @@ class ProtoArrayForkChoice:
             finalized_checkpoint,
             execution_status,
             execution_block_hash,
+            unrealized_justified_checkpoint,
         )
 
     def on_valid_execution_payload(self, root: bytes) -> None:
@@ -386,6 +455,7 @@ class ProtoArrayForkChoice:
         finalized_checkpoint: tuple[int, bytes],
         justified_state_balances: list[int],
         proposer_boost_amount: int = 0,
+        current_epoch: int | None = None,
     ) -> bytes:
         new_balances = justified_state_balances
         deltas = self._compute_deltas(new_balances)
@@ -408,6 +478,7 @@ class ProtoArrayForkChoice:
             finalized_checkpoint,
             boost_root,
             proposer_boost_amount,
+            current_epoch,
         )
         self.balances = list(new_balances)
         return self.proto_array.find_head(justified_checkpoint[1])

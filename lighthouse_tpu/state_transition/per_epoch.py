@@ -44,6 +44,93 @@ def process_epoch(state, preset: Preset, spec):
         _process_epoch_altair(state, preset, spec)
 
 
+def compute_unrealized_checkpoints(state, preset: Preset, spec):
+    """What (justified, finalized) WOULD become if the next epoch boundary
+    processed this state's attestations right now -- the fork-choice
+    unrealized-justification input (reference fork_choice.rs
+    compute_unrealized_checkpoints / state_processing's
+    per_epoch_processing::altair::participation_cache justifiability).
+
+    Runs the real weigh function against the live state, then restores the
+    four fields it mutates -- no state clone."""
+    current_epoch = _current_epoch(state, preset)
+    jc = (
+        state.current_justified_checkpoint.epoch,
+        bytes(state.current_justified_checkpoint.root),
+    )
+    fc = (
+        state.finalized_checkpoint.epoch,
+        bytes(state.finalized_checkpoint.root),
+    )
+    if current_epoch <= GENESIS_EPOCH + 1:
+        return jc, fc
+    if not hasattr(state, "previous_justified_checkpoint"):
+        # reduced/stub states without the justification machinery (test
+        # doubles): nothing unrealized to compute
+        return jc, fc
+
+    saved = (
+        state.previous_justified_checkpoint,
+        state.current_justified_checkpoint,
+        state.justification_bits,
+        state.finalized_checkpoint,
+    )
+    try:
+        previous_epoch = _previous_epoch(state, preset)
+        total_balance = _total_active_balance(state, preset, spec)
+        if state.fork_name == "phase0":
+            cache_map: dict = {}
+            prev_target = _attesting_indices(
+                state,
+                _matching_target_attestations(state, previous_epoch, preset),
+                preset,
+                spec,
+                cache_map,
+            )
+            # a state AT its epoch-start slot has no current-epoch block
+            # root yet (and necessarily no current-epoch attestations:
+            # inclusion delay >= 1)
+            try:
+                cur_matching = _matching_target_attestations(
+                    state, current_epoch, preset
+                )
+            except ValueError:
+                cur_matching = []
+            cur_target = _attesting_indices(
+                state, cur_matching, preset, spec, cache_map
+            )
+        else:
+            prev_target = _unslashed_participating_indices(
+                state, TIMELY_TARGET_FLAG_INDEX, previous_epoch, preset
+            )
+            cur_target = _unslashed_participating_indices(
+                state, TIMELY_TARGET_FLAG_INDEX, current_epoch, preset
+            )
+        _weigh_justification_and_finalization(
+            state,
+            total_balance,
+            get_total_balance(state, prev_target, spec),
+            get_total_balance(state, cur_target, spec),
+            preset,
+        )
+        ujc = (
+            state.current_justified_checkpoint.epoch,
+            bytes(state.current_justified_checkpoint.root),
+        )
+        ufc = (
+            state.finalized_checkpoint.epoch,
+            bytes(state.finalized_checkpoint.root),
+        )
+        return ujc, ufc
+    finally:
+        (
+            state.previous_justified_checkpoint,
+            state.current_justified_checkpoint,
+            state.justification_bits,
+            state.finalized_checkpoint,
+        ) = saved
+
+
 # ===========================================================================
 # shared machinery
 # ===========================================================================

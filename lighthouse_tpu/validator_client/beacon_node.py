@@ -252,7 +252,12 @@ class InProcessBeaconNode:
         else:
             parent_hash = self.chain.execution_layer.pre_merge_parent_hash
         signed_bid = self.builder.get_header(slot, parent_hash, proposer_pubkey)
-        verify_bid(signed_bid, self.spec, parent_hash)
+        verify_bid(
+            signed_bid,
+            self.spec,
+            parent_hash,
+            trusted_pubkey=getattr(self.builder, "trusted_pubkey", None),
+        )
 
         body = self._pack_body(
             t.BlindedBeaconBlockBody.default(), state, slot, randao_reveal,

@@ -213,8 +213,11 @@ class ValidatorClient:
         pushed = False
         for node in self.nodes.candidates:
             if node.is_healthy() and hasattr(node, "register_validators"):
-                node.register_validators(regs)
-                pushed = True
+                try:
+                    node.register_validators(regs)
+                    pushed = True
+                except Exception:  # noqa: BLE001 -- builder down must not
+                    continue  # abort the block/attestation duties below
         if pushed:
             self._registered_epochs.add(epoch)
             self._registered_epochs = {

@@ -214,3 +214,23 @@ class TestBidVerification:
                 verify_bid(bid, spec, engine.genesis_hash)
         finally:
             set_backend("fake")
+
+    def test_self_signed_foreign_key_bid_rejected(self):
+        """A relay minting its own key must not pass: bids are pinned to
+        the CONFIGURED builder identity, not the bid's embedded pubkey."""
+        t = types_for(MINIMAL)
+        engine = MockExecutionEngine(t)
+        el = ExecutionLayer(engine)
+        spec = ChainSpec.interop()
+        trusted = SecretKey(7).public_key().to_bytes()
+        impostor = MockBuilder(el, MINIMAL, spec, secret_key=SecretKey(666))
+        sk = SecretKey(11)
+        impostor.register_validators(
+            [make_validator_registration(sk, b"\xaa" * 20, 30_000_000, 5, spec)]
+        )
+        bid = impostor.get_header(
+            1, engine.genesis_hash, sk.public_key().to_bytes()
+        )
+        # self-consistent signature (fake backend passes it), wrong identity
+        with pytest.raises(BuilderError, match="unexpected builder key"):
+            verify_bid(bid, spec, engine.genesis_hash, trusted_pubkey=trusted)

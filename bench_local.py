@@ -139,7 +139,6 @@ def bench_op_pool_pack(n_attestations: int = 4096, validators: int = 256) -> dic
     from lighthouse_tpu.harness.chain import StateHarness
     from lighthouse_tpu.pool import OperationPool
     from lighthouse_tpu.state_transition import clone_state, process_slots
-    from lighthouse_tpu.state_transition.context import ConsensusContext
     from lighthouse_tpu.types import MINIMAL, types_for
 
     h = StateHarness(validators, MINIMAL, sign=False)
@@ -150,7 +149,6 @@ def bench_op_pool_pack(n_attestations: int = 4096, validators: int = 256) -> dic
     )
     pool = OperationPool(MINIMAL, h.spec)
     rng = random.Random(3)
-    ctxt = ConsensusContext(MINIMAL, h.spec)
     # fill until the pool RETAINS n_attestations distinct aggregates
     # (subset variants are deduped on insert), with an attempt cap
     attempts = 0
@@ -184,8 +182,11 @@ def bench_op_pool_pack(n_attestations: int = 4096, validators: int = 256) -> dic
 def main() -> None:
     mini = os.environ.get("BENCH_LOCAL_SCALE") == "mini"
     _force_cpu()
-    results = [
-        bench_verifier_mesh(8),
+    results = []
+    if not mini:
+        # compile-bound (minutes when the XLA cache is cold): full runs only
+        results.append(bench_verifier_mesh(8))
+    results += [
         bench_epoch_transition(2_000 if mini else 100_000),
         bench_cached_tree_hash(2_048 if mini else 16_384),
         bench_op_pool_pack(256 if mini else 4096, 64 if mini else 256),

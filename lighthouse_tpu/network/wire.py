@@ -9,26 +9,31 @@ so a `NetworkNode` runs unchanged over real TCP sockets:
 - every payload crosses the wire as **SSZ + snappy** (snappy.py), with
   gossip topics in the reference's fork-digest namespacing and req/resp
   responses in varint-length-prefixed chunks (ssz_snappy.rs framing);
-- gossip is flood-published with a seen-cache (the gossipsub seat —
-  mesh management/scoring stays in NetworkNode's peer-score table);
+- gossip rides a degree-bounded MESH per topic (gossipsub's eager-push
+  mesh, behaviour.rs/mesh maintenance) with a seen-cache: each node
+  relays to at most MESH_DEGREE mesh peers instead of flooding every
+  subscriber (peer scoring stays in NetworkNode's score table);
+- connections are PERSISTENT: one long-lived outbound socket per peer,
+  reused for every gossip push and req/resp exchange (the reference's
+  noise/yamux stream seat), redialed once on failure;
+- req/resp is token-bucket rate-limited PER PEER on the server side
+  (reference rpc/rate_limiter.rs): an over-quota requester gets an
+  error chunk, not service;
 - `Bootnode` is a registry server standing in for discv5: peers
   REGISTER their (peer_id, host, port) and LIST others (discovery/'s
   ENR directory role; the UDP DHT itself is out of scope).
-
-Connections are short-lived per message (localhost test fabric, one
-frame exchange per dial), which sidesteps muxer state; the reference's
-long-lived noise/yamux streams are a transport optimization behind the
-same message semantics.
 
 NOTE: no `from __future__ import annotations` — the @container wire types
 below need live annotations (see types/containers.py header)."""
 
 import hashlib
 import json
+import random
 import socket
 import socketserver
 import struct
 import threading
+import time
 from collections import OrderedDict
 
 from ..ssz import Bytes4, Bytes32, List, container, uint64
@@ -39,8 +44,95 @@ FRAME_HELLO = 0
 FRAME_GOSSIP = 1
 FRAME_REQ = 2
 FRAME_RESP = 3
+FRAME_GRAFT = 4
+FRAME_PRUNE = 5
 
 SEEN_CACHE_SIZE = 4096
+# gossipsub mesh degree (the reference's D; config.rs mesh_n)
+MESH_DEGREE = 4
+
+
+class TokenBucket:
+    """Per-peer request quota (reference rpc/rate_limiter.rs): `burst`
+    tokens, refilled at `rate_per_s`."""
+
+    def __init__(self, burst: float, rate_per_s: float):
+        self.capacity = float(burst)
+        self.rate = float(rate_per_s)
+        self.tokens = float(burst)
+        self.stamp = time.monotonic()
+
+    def allow(self, cost: float = 1.0) -> bool:
+        now = time.monotonic()
+        self.tokens = min(
+            self.capacity, self.tokens + (now - self.stamp) * self.rate
+        )
+        self.stamp = now
+        if self.tokens >= cost:
+            self.tokens -= cost
+            return True
+        return False
+
+
+class _PeerConn:
+    """One persistent outbound socket to a peer, serialized by a lock;
+    redials once when the cached socket has died."""
+
+    def __init__(self, host: str, port: int):
+        self.host = host
+        self.port = port
+        self.lock = threading.Lock()
+        self._sock: socket.socket | None = None
+
+    def _dial(self) -> socket.socket:
+        s = socket.create_connection((self.host, self.port), timeout=10)
+        s.settimeout(10)
+        return s
+
+    def _get(self) -> socket.socket:
+        if self._sock is None:
+            self._sock = self._dial()
+        return self._sock
+
+    def _drop(self) -> None:
+        if self._sock is not None:
+            try:
+                self._sock.close()
+            except OSError:
+                pass
+            self._sock = None
+
+    def send(self, ftype: int, body: bytes) -> None:
+        """Fire-and-forget frame (gossip push)."""
+        with self.lock:
+            for attempt in (0, 1):
+                try:
+                    _send_frame(self._get(), ftype, body)
+                    return
+                except OSError:
+                    self._drop()
+                    if attempt:
+                        raise
+
+    def exchange(self, ftype: int, body: bytes):
+        """Frame out, response frame back on the same stream."""
+        with self.lock:
+            for attempt in (0, 1):
+                try:
+                    s = self._get()
+                    _send_frame(s, ftype, body)
+                    rtype, resp = _recv_frame(s)
+                    if rtype is None:
+                        raise OSError("peer closed mid-exchange")
+                    return rtype, resp
+                except OSError:
+                    self._drop()
+                    if attempt:
+                        raise
+
+    def close(self) -> None:
+        with self.lock:
+            self._drop()
 
 
 # NOTE: no `from __future__ annotations` interplay — these descriptors are
@@ -290,7 +382,14 @@ class WireBus:
     per node (unlike the shared in-process MessageBus); `listen()` then
     `bootstrap()`/`connect_to()` wire it into the network."""
 
-    def __init__(self, preset, host: str = "127.0.0.1"):
+    def __init__(
+        self,
+        preset,
+        host: str = "127.0.0.1",
+        mesh_degree: int = MESH_DEGREE,
+        req_burst: float = 16.0,
+        req_rate_per_s: float = 8.0,
+    ):
         self.codec = WireCodec(preset)
         self.host = host
         self.peer_id: str | None = None
@@ -299,9 +398,18 @@ class WireBus:
         self._rpc: dict[str, object] = {}  # protocol -> handler
         # peer_id -> {"host", "port", "topics": set}
         self._peers: dict[str, dict] = {}
+        self._conns: dict[str, _PeerConn] = {}  # persistent outbound
+        self.mesh_degree = mesh_degree
+        self._mesh: dict[str, set] = {}  # topic -> mesh peer ids
+        # peers that PRUNEd our graft, per topic: excluded from re-grafts
+        self._pruned_by: dict[str, set] = {}
+        self.req_burst = req_burst
+        self.req_rate_per_s = req_rate_per_s
         self._seen: OrderedDict[bytes, bool] = OrderedDict()
         self._lock = threading.Lock()
         self._server = None
+        # observability for mesh/limiter tests
+        self.stats = {"gossip_frames_sent": 0, "requests_rejected": 0}
 
     # -- MessageBus API ------------------------------------------------------
 
@@ -326,24 +434,21 @@ class WireBus:
         data = self.codec.encode_gossip(topic, payload)
         msg_id = self._msg_id(topic, data)
         self._mark_seen(msg_id)
-        return self._flood(topic, data, exclude=None)
+        return self._gossip_send(topic, data, exclude=None)
 
     def request(self, from_peer: str, to_peer: str, protocol: str, payload):
-        with self._lock:
-            info = self._peers.get(to_peer)
-        if info is None:
+        conn = self._conn_for(to_peer)
+        if conn is None:
             raise ConnectionError(f"unknown peer {to_peer}")
         body = (
             struct.pack(">H", len(protocol))
             + protocol.encode()
+            + struct.pack(">H", len(self.peer_id))
+            + self.peer_id.encode()
             + self.codec.encode_request(protocol, payload)
         )
         try:
-            with socket.create_connection(
-                (info["host"], info["port"]), timeout=10
-            ) as s:
-                _send_frame(s, FRAME_REQ, body)
-                ftype, resp = _recv_frame(s)
+            ftype, resp = conn.exchange(FRAME_REQ, body)
         except OSError as e:
             raise ConnectionError(f"peer {to_peer} unreachable: {e}") from None
         if ftype != FRAME_RESP or resp is None:
@@ -362,11 +467,15 @@ class WireBus:
 
         class Handler(socketserver.BaseRequestHandler):
             def handle(self):
+                # the quota is keyed to the CONNECTION, not a requester id
+                # copied from the request body -- ids are free to rotate,
+                # re-dialing costs the flooder a handshake per bucket
+                bucket = TokenBucket(outer.req_burst, outer.req_rate_per_s)
                 while True:
                     ftype, body = _recv_frame(self.request)
                     if ftype is None:
                         return
-                    outer._handle_frame(self.request, ftype, body)
+                    outer._handle_frame(self.request, ftype, body, bucket)
 
         self._server = socketserver.ThreadingTCPServer(
             (self.host, port), Handler, bind_and_activate=True
@@ -382,6 +491,11 @@ class WireBus:
         if self._server is not None:
             self._server.shutdown()
             self._server.server_close()
+        with self._lock:
+            conns = list(self._conns.values())
+            self._conns.clear()
+        for conn in conns:
+            conn.close()
 
     def connect_to(self, host: str, port: int) -> str | None:
         """Dial a peer: HELLO exchange records each other's listen
@@ -442,6 +556,70 @@ class WireBus:
                 "port": peer["port"],
                 "topics": set(peer.get("topics", ())),
             }
+            # mesh maintenance: a new subscriber can graft into any topic
+            # mesh that is below degree; grafts are SYMMETRIC (gossipsub
+            # GRAFT control) so the mesh union is an undirected connected
+            # graph, not a one-way star
+            graft_topics = []
+            for topic in peer.get("topics", ()):
+                # a topology change invalidates stale prune verdicts
+                self._pruned_by.get(topic, set()).discard(peer["peer_id"])
+                mesh = self._mesh.setdefault(topic, set())
+                if (
+                    peer["peer_id"] not in mesh
+                    and len(mesh) < self.mesh_degree
+                ):
+                    mesh.add(peer["peer_id"])
+                    graft_topics.append(topic)
+        for topic in graft_topics:
+            self._send_graft(peer["peer_id"], topic)
+
+    def _send_graft(self, peer_id: str, topic: str) -> None:
+        conn = self._conn_for(peer_id)
+        if conn is None:
+            return
+        try:
+            conn.send(
+                FRAME_GRAFT,
+                json.dumps(
+                    {"peer_id": self.peer_id, "topic": topic}
+                ).encode(),
+            )
+        except OSError:
+            pass
+
+    def _conn_for(self, peer_id: str) -> "_PeerConn | None":
+        with self._lock:
+            info = self._peers.get(peer_id)
+            if info is None:
+                return None
+            conn = self._conns.get(peer_id)
+            if conn is None:
+                conn = self._conns[peer_id] = _PeerConn(
+                    info["host"], info["port"]
+                )
+            return conn
+
+    def _drop_peer(self, peer_id: str) -> None:
+        with self._lock:
+            self._peers.pop(peer_id, None)
+            conn = self._conns.pop(peer_id, None)
+            for mesh in self._mesh.values():
+                mesh.discard(peer_id)
+        if conn is not None:
+            conn.close()
+        # backfill meshes from remaining subscribers
+        with self._lock:
+            for topic, mesh in self._mesh.items():
+                if len(mesh) < self.mesh_degree:
+                    candidates = [
+                        pid
+                        for pid, info in self._peers.items()
+                        if topic in info["topics"] and pid not in mesh
+                    ]
+                    random.shuffle(candidates)
+                    for pid in candidates[: self.mesh_degree - len(mesh)]:
+                        mesh.add(pid)
 
     def _msg_id(self, topic: str, data: bytes) -> bytes:
         return hashlib.sha256(topic.encode() + data).digest()[:20]
@@ -456,7 +634,10 @@ class WireBus:
                 self._seen.popitem(last=False)
             return True
 
-    def _flood(self, topic: str, data: bytes, exclude: str | None) -> int:
+    def _gossip_send(self, topic: str, data: bytes, exclude: str | None) -> int:
+        """Eager-push to the topic MESH over persistent connections (the
+        gossipsub relay; flood only if the mesh is empty but subscribers
+        exist, which covers bootstrap races)."""
         body = (
             struct.pack(">H", len(topic))
             + topic.encode()
@@ -465,24 +646,30 @@ class WireBus:
             + data
         )
         with self._lock:
-            targets = [
-                (pid, info)
+            mesh = set(self._mesh.get(topic, ()))
+            subscribers = {
+                pid
                 for pid, info in self._peers.items()
-                if topic in info["topics"] and pid != exclude
-            ]
+                if topic in info["topics"]
+            }
+        subscribers.discard(exclude)
+        # exclude FIRST: a mesh shrunk to exactly the upstream sender must
+        # fall back to the other known subscribers, not relay to nobody
+        targets = (mesh & subscribers) or subscribers
         sent = 0
-        for pid, info in targets:
-            try:
-                with socket.create_connection(
-                    (info["host"], info["port"]), timeout=10
-                ) as s:
-                    _send_frame(s, FRAME_GOSSIP, body)
-                sent += 1
-            except OSError:
+        for pid in targets:
+            conn = self._conn_for(pid)
+            if conn is None:
                 continue
+            try:
+                conn.send(FRAME_GOSSIP, body)
+                sent += 1
+                self.stats["gossip_frames_sent"] += 1
+            except OSError:
+                self._drop_peer(pid)
         return sent
 
-    def _handle_frame(self, sock, ftype: int, body: bytes) -> None:
+    def _handle_frame(self, sock, ftype: int, body: bytes, bucket=None) -> None:
         if ftype == FRAME_HELLO:
             peer = json.loads(body)
             self._record_peer(peer)
@@ -493,6 +680,76 @@ class WireBus:
                 "topics": sorted(self._subs),
             }
             _send_frame(sock, FRAME_HELLO, json.dumps(reply).encode())
+            return
+        if ftype == FRAME_GRAFT:
+            msg = json.loads(body)
+            topic = msg["topic"]
+            refuse = False
+            with self._lock:
+                if msg["peer_id"] in self._peers:
+                    mesh = self._mesh.setdefault(topic, set())
+                    if msg["peer_id"] in mesh:
+                        pass
+                    elif len(mesh) < 2 * self.mesh_degree:
+                        # accept grafts up to 2x degree (gossipsub D_high)
+                        mesh.add(msg["peer_id"])
+                    else:
+                        refuse = True
+            if refuse:
+                # full mesh: PRUNE so the grafter re-grafts elsewhere,
+                # carrying peer-exchange suggestions (gossipsub PX) so a
+                # late joiner facing saturated meshes still finds a seat
+                with self._lock:
+                    px = random.sample(
+                        sorted(self._mesh.get(topic, ())),
+                        k=min(2, len(self._mesh.get(topic, ()))),
+                    )
+                conn = self._conn_for(msg["peer_id"])
+                if conn is not None:
+                    try:
+                        conn.send(
+                            FRAME_PRUNE,
+                            json.dumps(
+                                {
+                                    "peer_id": self.peer_id,
+                                    "topic": topic,
+                                    "px": px,
+                                }
+                            ).encode(),
+                        )
+                    except OSError:
+                        pass
+            return
+        if ftype == FRAME_PRUNE:
+            msg = json.loads(body)
+            topic = msg["topic"]
+            with self._lock:
+                self._mesh.get(topic, set()).discard(msg["peer_id"])
+                self._pruned_by.setdefault(topic, set()).add(msg["peer_id"])
+                mesh = self._mesh.setdefault(topic, set())
+                # PX suggestions first (they have capacity signals), then
+                # any other known subscriber we have not been pruned by
+                candidates = [
+                    pid
+                    for pid in msg.get("px", ())
+                    if pid in self._peers
+                    and pid != self.peer_id
+                    and pid not in mesh
+                ]
+                others = [
+                    pid
+                    for pid, info in self._peers.items()
+                    if topic in info["topics"]
+                    and pid not in mesh
+                    and pid not in self._pruned_by[topic]
+                    and pid not in candidates
+                ]
+                random.shuffle(others)
+                candidates.extend(others)
+                chosen = candidates[: max(self.mesh_degree - len(mesh), 1)]
+                mesh.update(chosen)
+            for pid in chosen:
+                self._send_graft(pid, topic)
             return
         if ftype == FRAME_GOSSIP:
             (tlen,) = struct.unpack_from(">H", body, 0)
@@ -507,13 +764,22 @@ class WireBus:
             if handler is not None:
                 payload = self.codec.decode_gossip(topic, data)
                 handler(payload, source)
-            # flood onward (gossipsub relay), not back to the sender
-            self._flood(topic, data, exclude=source)
+            # relay onward through the mesh, not back to the sender
+            self._gossip_send(topic, data, exclude=source)
             return
         if ftype == FRAME_REQ:
             (plen,) = struct.unpack_from(">H", body, 0)
             protocol = body[2 : 2 + plen].decode()
-            data = body[2 + plen :]
+            pos = 2 + plen
+            (rlen,) = struct.unpack_from(">H", body, pos)
+            requester = body[pos + 2 : pos + 2 + rlen].decode()
+            data = body[pos + 2 + rlen :]
+            # per-connection token bucket (rpc/rate_limiter.rs):
+            # over-quota requesters get an error chunk, not service
+            if bucket is not None and not bucket.allow():
+                self.stats["requests_rejected"] += 1
+                _send_frame(sock, FRAME_RESP, b"\x01rate limited")
+                return
             handler = self._rpc.get(protocol)
             if handler is None:
                 _send_frame(
@@ -522,7 +788,7 @@ class WireBus:
                 return
             try:
                 payload = self.codec.decode_request(protocol, data)
-                result = handler(payload, "remote")
+                result = handler(payload, requester or "remote")
                 _send_frame(
                     sock,
                     FRAME_RESP,

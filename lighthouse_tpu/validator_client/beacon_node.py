@@ -251,13 +251,15 @@ class InProcessBeaconNode:
             parent_hash = bytes(state.latest_execution_payload_header.block_hash)
         else:
             parent_hash = self.chain.execution_layer.pre_merge_parent_hash
+        trusted = getattr(self.builder, "trusted_pubkey", None)
+        if trusted is None:
+            # fail closed: an unpinned builder identity lets a relay burn
+            # the proposer's slot with a self-signed bid (see verify_bid)
+            raise BuilderError(
+                "builder has no pinned identity (trusted_pubkey)"
+            )
         signed_bid = self.builder.get_header(slot, parent_hash, proposer_pubkey)
-        verify_bid(
-            signed_bid,
-            self.spec,
-            parent_hash,
-            trusted_pubkey=getattr(self.builder, "trusted_pubkey", None),
-        )
+        verify_bid(signed_bid, self.spec, parent_hash, trusted_pubkey=trusted)
 
         body = self._pack_body(
             t.BlindedBeaconBlockBody.default(), state, slot, randao_reveal,

@@ -42,7 +42,9 @@ def _bellatrix_rig(validators=16):
     assert h.chain.head_state.fork_name == "bellatrix"
     builder = MockBuilder(el, MINIMAL, spec, chain=h.chain)
     server = BuilderHttpServer(builder).start()
-    client = BuilderHttpClient(server.url, MINIMAL)
+    client = BuilderHttpClient(
+        server.url, MINIMAL, trusted_pubkey=builder.pubkey.to_bytes()
+    )
     return h, builder, server, client, spec
 
 

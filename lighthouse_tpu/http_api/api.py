@@ -30,9 +30,11 @@ class ApiError(ValueError):
 class BeaconApi:
     """Route handlers; names mirror the eth2 API paths."""
 
-    def __init__(self, node: InProcessBeaconNode):
+    def __init__(self, node: InProcessBeaconNode, network=None):
         self.node = node
         self.chain = node.chain
+        # optional NetworkNode for the node/peers routes
+        self.network = network
         self.events: list = []  # (kind, payload) journal for SSE
         self.chain.event_sinks.append(
             lambda kind, payload: self.events.append((kind, payload))
@@ -116,32 +118,37 @@ class BeaconApi:
             }
         }
 
+    @staticmethod
+    def _validator_entry(s, epoch: int, i: int) -> dict:
+        v = s.validators[i]
+        if v.activation_epoch > epoch:
+            status = "pending"
+        elif epoch < v.exit_epoch:
+            status = "active_ongoing"
+        else:
+            status = "exited"
+        return {
+            "index": str(i),
+            "balance": str(s.balances[i]),
+            "status": status,
+            "validator": {
+                "pubkey": hexs(v.pubkey),
+                "effective_balance": str(v.effective_balance),
+                "slashed": bool(v.slashed),
+                "activation_epoch": str(v.activation_epoch),
+                "exit_epoch": str(v.exit_epoch),
+            },
+        }
+
     def get_validators(self, state_id: str) -> dict:
         s = self._state(state_id)
         epoch = compute_epoch_at_slot(s.slot, self.chain.preset)
-        out = []
-        for i, v in enumerate(s.validators):
-            if v.activation_epoch > epoch:
-                status = "pending"
-            elif epoch < v.exit_epoch:
-                status = "active_ongoing"
-            else:
-                status = "exited"
-            out.append(
-                {
-                    "index": str(i),
-                    "balance": str(s.balances[i]),
-                    "status": status,
-                    "validator": {
-                        "pubkey": hexs(v.pubkey),
-                        "effective_balance": str(v.effective_balance),
-                        "slashed": bool(v.slashed),
-                        "activation_epoch": str(v.activation_epoch),
-                        "exit_epoch": str(v.exit_epoch),
-                    },
-                }
-            )
-        return {"data": out}
+        return {
+            "data": [
+                self._validator_entry(s, epoch, i)
+                for i in range(len(s.validators))
+            ]
+        }
 
     def get_block(self, block_id: str) -> dict:
         root = self._block_root(block_id)
@@ -268,7 +275,337 @@ class BeaconApi:
         )
         return {}
 
+    def get_validator(self, state_id: str, validator_id: str) -> dict:
+        """/eth/v1/beacon/states/{id}/validators/{validator_id}: by index
+        or 0x pubkey; only the requested entry is built."""
+        s = self._state(state_id)
+        if validator_id.startswith("0x"):
+            pk = unhex(validator_id)
+            matches = [
+                i for i, v in enumerate(s.validators) if bytes(v.pubkey) == pk
+            ]
+            if not matches:
+                raise ApiError(404, "validator not found")
+            index = matches[0]
+        else:
+            if not validator_id.isdigit():  # rejects negatives + garbage
+                raise ApiError(400, f"bad validator id {validator_id!r}")
+            index = int(validator_id)
+            if index >= len(s.validators):
+                raise ApiError(404, "validator not found")
+        epoch = compute_epoch_at_slot(s.slot, self.chain.preset)
+        return {"data": self._validator_entry(s, epoch, index)}
+
+    def get_validator_balances(self, state_id: str) -> dict:
+        s = self._state(state_id)
+        return {
+            "data": [
+                {"index": str(i), "balance": str(b)}
+                for i, b in enumerate(s.balances)
+            ]
+        }
+
+    def get_committees(self, state_id: str, epoch: int | None = None) -> dict:
+        from ..state_transition.context import ConsensusContext
+
+        s = self._state(state_id)
+        preset = self.chain.preset
+        if epoch is None:
+            epoch = compute_epoch_at_slot(s.slot, preset)
+        ctxt = ConsensusContext(preset, self.chain.spec)
+        cache = ctxt.committee_cache(s, epoch)
+        start = epoch * preset.slots_per_epoch
+        out = []
+        for slot in range(start, start + preset.slots_per_epoch):
+            for index in range(cache.committees_per_slot):
+                out.append(
+                    {
+                        "index": str(index),
+                        "slot": str(slot),
+                        "validators": [
+                            str(v)
+                            for v in cache.get_beacon_committee(slot, index)
+                        ],
+                    }
+                )
+        return {"data": out}
+
+    def get_sync_committees(self, state_id: str) -> dict:
+        s = self._state(state_id)
+        if not hasattr(s, "current_sync_committee"):
+            raise ApiError(400, "state predates altair")
+        pk_to_idx = {
+            bytes(v.pubkey): i for i, v in enumerate(s.validators)
+        }
+        indices = [
+            str(pk_to_idx.get(bytes(pk), 0))
+            for pk in s.current_sync_committee.pubkeys
+        ]
+        return {"data": {"validators": indices}}
+
+    def get_block_root(self, block_id: str) -> dict:
+        root = self._block_root(block_id)
+        if self.chain.store.get_block_any_temperature(root) is None:
+            raise ApiError(404, "block not found")
+        return {"data": {"root": hexs(root)}}
+
+    def get_block_attestations(self, block_id: str) -> dict:
+        root = self._block_root(block_id)
+        blk = self.chain.store.get_block_any_temperature(root)
+        if blk is None:
+            raise ApiError(404, "block not found")
+        return {
+            "data": [
+                {"ssz": hexs(a.as_ssz_bytes())}
+                for a in blk.message.body.attestations
+            ]
+        }
+
+    # -- pool routes (exits / slashings / sync messages) ---------------------
+
+    def get_pool_voluntary_exits(self) -> dict:
+        return {
+            "data": [
+                {"ssz": hexs(e.as_ssz_bytes())}
+                for e in self.node.op_pool._voluntary_exits.values()
+            ]
+        }
+
+    def get_pool_proposer_slashings(self) -> dict:
+        return {
+            "data": [
+                {"ssz": hexs(s.as_ssz_bytes())}
+                for s in self.node.op_pool._proposer_slashings.values()
+            ]
+        }
+
+    def get_pool_attester_slashings(self) -> dict:
+        return {
+            "data": [
+                {"ssz": hexs(s.as_ssz_bytes())}
+                for s in self.node.op_pool._attester_slashings
+            ]
+        }
+
+    def post_pool_voluntary_exits(self, ssz_hex: str) -> dict:
+        from ..types.containers import SignedVoluntaryExit
+
+        exit_op = SignedVoluntaryExit.from_ssz_bytes(unhex(ssz_hex))
+        publish = getattr(self.network, "publish_voluntary_exit", None)
+        if publish is not None:
+            publish(exit_op)
+        else:
+            self.node.op_pool.insert_voluntary_exit(exit_op)
+        return {}
+
+    def post_pool_sync_committees(self, messages: list[dict]) -> dict:
+        from ..types.containers import SyncCommitteeMessage
+
+        for m in messages:
+            msg = SyncCommitteeMessage.from_ssz_bytes(unhex(m["ssz"]))
+            self.node.publish_sync_message(msg, int(m.get("subnet", 0)))
+        return {}
+
+    # -- sync-committee validator routes -------------------------------------
+
+    def post_sync_duties(self, epoch: int, indices: list[int]) -> dict:
+        duties = self.node.get_sync_duties(epoch, indices)
+        state = self.chain.head_state
+        size = (
+            self.chain.preset.sync_committee_size
+            // self.chain.preset.sync_committee_subnet_count
+        )
+        out = []
+        for d in duties:
+            # wire shape: positions within the FULL committee
+            # (validator_sync_committee_indices, per the eth2 API spec);
+            # in-process shape: {subnet: positions-in-subcommittee}
+            committee_positions = [
+                subnet * size + pos
+                for subnet, positions in d["subnets"].items()
+                for pos in positions
+            ]
+            out.append(
+                {
+                    "pubkey": hexs(
+                        state.validators[d["validator_index"]].pubkey
+                    ),
+                    "validator_index": str(d["validator_index"]),
+                    "validator_sync_committee_indices": [
+                        str(i) for i in committee_positions
+                    ],
+                }
+            )
+        return {"data": out}
+
+    def sync_committee_contribution(
+        self, slot: int, subcommittee_index: int, beacon_block_root: str
+    ) -> dict:
+        contribution = self.node.get_sync_contribution(
+            slot, unhex(beacon_block_root), subcommittee_index
+        )
+        if contribution is None:
+            raise ApiError(404, "no matching contribution")
+        return {"data": {"ssz": hexs(contribution.as_ssz_bytes())}}
+
+    def post_contribution_and_proofs(self, items_ssz: list[str]) -> dict:
+        from ..types import types_for as _tf
+
+        t = _tf(self.chain.preset)
+        for ssz_hex in items_ssz:
+            self.node.publish_contribution_and_proof(
+                t.SignedContributionAndProof.from_ssz_bytes(unhex(ssz_hex))
+            )
+        return {}
+
+    # -- builder routes -------------------------------------------------------
+
+    def register_validator(self, registrations_ssz: list[str]) -> dict:
+        """POST /eth/v1/validator/register_validator: forward signed
+        builder registrations (builder fan-out seat)."""
+        from ..types.containers import SignedValidatorRegistration
+
+        regs = [
+            SignedValidatorRegistration.from_ssz_bytes(unhex(r))
+            for r in registrations_ssz
+        ]
+        self.node.register_validators(regs)
+        return {}
+
+    def produce_blinded_block(self, slot: int, randao_reveal: str) -> dict:
+        block = self.node.produce_blinded_block(slot, unhex(randao_reveal))
+        return {
+            "version": "bellatrix",
+            "data": {"ssz": hexs(block.as_ssz_bytes())},
+        }
+
+    def post_blinded_block(self, ssz_hex: str) -> dict:
+        t = types_for(self.chain.preset)
+        signed = t.SignedBlindedBeaconBlock.from_ssz_bytes(unhex(ssz_hex))
+        root = self.node.publish_blinded_block(signed)
+        return {"data": {"root": hexs(root)}}
+
+    # -- config namespace -----------------------------------------------------
+
+    def get_spec(self) -> dict:
+        """/eth/v1/config/spec: the runtime chain configuration."""
+        spec = self.chain.spec
+        preset = self.chain.preset
+        out = {
+            "CONFIG_NAME": spec.config_name,
+            "GENESIS_FORK_VERSION": hexs(spec.genesis_fork_version),
+            "ALTAIR_FORK_VERSION": hexs(spec.altair_fork_version),
+            "BELLATRIX_FORK_VERSION": hexs(spec.bellatrix_fork_version),
+            "SECONDS_PER_SLOT": str(spec.seconds_per_slot),
+            "SLOTS_PER_EPOCH": str(preset.slots_per_epoch),
+            "MAX_VALIDATORS_PER_COMMITTEE": str(
+                preset.max_validators_per_committee
+            ),
+            "MAX_COMMITTEES_PER_SLOT": str(preset.max_committees_per_slot),
+            "MAX_EFFECTIVE_BALANCE": str(spec.max_effective_balance),
+            "SHARD_COMMITTEE_PERIOD": str(spec.shard_committee_period),
+            "PROPOSER_SCORE_BOOST": str(spec.proposer_score_boost),
+        }
+        if spec.altair_fork_epoch is not None:
+            out["ALTAIR_FORK_EPOCH"] = str(spec.altair_fork_epoch)
+        if spec.bellatrix_fork_epoch is not None:
+            out["BELLATRIX_FORK_EPOCH"] = str(spec.bellatrix_fork_epoch)
+        return {"data": out}
+
+    def get_fork_schedule(self) -> dict:
+        spec = self.chain.spec
+        forks = [
+            {
+                "previous_version": hexs(spec.genesis_fork_version),
+                "current_version": hexs(spec.genesis_fork_version),
+                "epoch": "0",
+            }
+        ]
+        if spec.altair_fork_epoch is not None:
+            forks.append(
+                {
+                    "previous_version": hexs(spec.genesis_fork_version),
+                    "current_version": hexs(spec.altair_fork_version),
+                    "epoch": str(spec.altair_fork_epoch),
+                }
+            )
+        if spec.bellatrix_fork_epoch is not None:
+            forks.append(
+                {
+                    "previous_version": hexs(spec.altair_fork_version),
+                    "current_version": hexs(spec.bellatrix_fork_version),
+                    "epoch": str(spec.bellatrix_fork_epoch),
+                }
+            )
+        return {"data": forks}
+
+    def get_deposit_contract(self) -> dict:
+        from ..eth1.jsonrpc import DEPOSIT_CONTRACT_ADDRESS
+
+        return {
+            "data": {
+                "chain_id": "1",
+                "address": DEPOSIT_CONTRACT_ADDRESS,
+            }
+        }
+
+    # -- debug namespace ------------------------------------------------------
+
+    def get_debug_state(self, state_id: str) -> dict:
+        """/eth/v2/debug/beacon/states/{id}: the full SSZ state."""
+        s = self._state(state_id)
+        return {
+            "version": s.fork_name,
+            "data": {"ssz": hexs(s.as_ssz_bytes())},
+        }
+
+    def get_debug_heads(self) -> dict:
+        pa = self.chain.fork_choice.proto.proto_array
+        children = {n.parent for n in pa.nodes if n.parent is not None}
+        return {
+            "data": [
+                {"root": hexs(n.root), "slot": str(n.slot)}
+                for i, n in enumerate(pa.nodes)
+                if i not in children
+            ]
+        }
+
     # -- node namespace ------------------------------------------------------
+
+    def get_identity(self) -> dict:
+        peer_id = getattr(self.network, "peer_id", "in-process")
+        return {"data": {"peer_id": peer_id, "metadata": {}}}
+
+    def get_peers(self) -> dict:
+        if self.network is None:
+            return {"data": [], "meta": {"count": 0}}
+        peers = []
+        for pid, score in self.network.peer_scores.items():
+            peers.append(
+                {
+                    "peer_id": pid,
+                    "state": (
+                        "disconnected"
+                        if self.network.is_banned(pid)
+                        else "connected"
+                    ),
+                    "score": str(score),
+                }
+            )
+        # bus-known peers without recorded scores
+        bus_peers = getattr(self.network.bus, "_peers", {})
+        for pid in bus_peers:
+            if pid not in self.network.peer_scores:
+                peers.append(
+                    {"peer_id": pid, "state": "connected", "score": "0"}
+                )
+        return {"data": peers, "meta": {"count": len(peers)}}
+
+    def get_peer(self, peer_id: str) -> dict:
+        for p in self.get_peers()["data"]:
+            if p["peer_id"] == peer_id:
+                return {"data": p}
+        raise ApiError(404, "peer not found")
 
     def get_health(self) -> int:
         return 200 if self.node.is_healthy() else 503

@@ -198,3 +198,81 @@ class BeaconNodeHttpClient:
                 for p in preparations
             ],
         )
+
+    # -- sync-committee duties over the wire (duties_service/sync.rs) --------
+
+    def get_sync_duties(self, epoch: int, indices) -> list[dict]:
+        data = self._post(
+            f"/eth/v1/validator/duties/sync/{epoch}",
+            [str(i) for i in indices],
+        )["data"]
+        size = (
+            self.preset.sync_committee_size
+            // self.preset.sync_committee_subnet_count
+        )
+        out = []
+        for d in data:
+            subnets: dict[int, list[int]] = {}
+            for i in d["validator_sync_committee_indices"]:
+                i = int(i)
+                subnets.setdefault(i // size, []).append(i % size)
+            out.append(
+                {
+                    "validator_index": int(d["validator_index"]),
+                    "subnets": subnets,
+                }
+            )
+        return out
+
+    def publish_sync_message(self, message, subnet: int = 0) -> None:
+        self._post(
+            "/eth/v1/beacon/pool/sync_committees",
+            [{"ssz": "0x" + message.as_ssz_bytes().hex(), "subnet": subnet}],
+        )
+
+    def get_sync_contribution(self, slot: int, block_root: bytes, subnet: int):
+        from ..types import types_for as _tf
+
+        try:
+            resp = self._get(
+                "/eth/v1/validator/sync_committee_contribution"
+                f"?slot={slot}&subcommittee_index={subnet}"
+                f"&beacon_block_root=0x{bytes(block_root).hex()}"
+            )
+        except Eth2ClientError:
+            return None
+        t = _tf(self.preset)
+        raw = bytes.fromhex(resp["data"]["ssz"].removeprefix("0x"))
+        return t.SyncCommitteeContribution.from_ssz_bytes(raw)
+
+    def publish_contribution_and_proof(self, signed_contribution) -> None:
+        self._post(
+            "/eth/v1/validator/contribution_and_proofs",
+            ["0x" + signed_contribution.as_ssz_bytes().hex()],
+        )
+
+    # -- builder registrations over the wire ---------------------------------
+
+    def register_validators(self, registrations) -> None:
+        self._post(
+            "/eth/v1/validator/register_validator",
+            ["0x" + r.as_ssz_bytes().hex() for r in registrations],
+        )
+
+    # -- inspection endpoints -------------------------------------------------
+
+    def spec(self) -> dict:
+        return self._get("/eth/v1/config/spec")["data"]
+
+    def peers(self) -> list[dict]:
+        return self._get("/eth/v1/node/peers")["data"]
+
+    def debug_state(self, state_id: str = "head"):
+        from ..types import state_class_for
+
+        resp = self._get(f"/eth/v2/debug/beacon/states/{state_id}")
+        t = types_for(self.preset)
+        cls = state_class_for(t, resp["version"])
+        return cls.from_ssz_bytes(
+            bytes.fromhex(resp["data"]["ssz"].removeprefix("0x"))
+        )

@@ -117,6 +117,82 @@ class BeaconApiServer:
                     ),
                     (r"^/eth/v1/node/version$", lambda m: api.get_version()),
                     (r"^/eth/v1/node/syncing$", lambda m: api.get_syncing()),
+                    (r"^/eth/v1/node/identity$", lambda m: api.get_identity()),
+                    (r"^/eth/v1/node/peers$", lambda m: api.get_peers()),
+                    (
+                        r"^/eth/v1/node/peers/([^/]+)$",
+                        lambda m: api.get_peer(m.group(1)),
+                    ),
+                    (
+                        r"^/eth/v1/beacon/states/([^/]+)/validators/([^/]+)$",
+                        lambda m: api.get_validator(m.group(1), m.group(2)),
+                    ),
+                    (
+                        r"^/eth/v1/beacon/states/([^/]+)/validator_balances$",
+                        lambda m: api.get_validator_balances(m.group(1)),
+                    ),
+                    (
+                        r"^/eth/v1/beacon/states/([^/]+)/committees$",
+                        lambda m: api.get_committees(
+                            m.group(1),
+                            int(params["epoch"]) if "epoch" in params else None,
+                        ),
+                    ),
+                    (
+                        r"^/eth/v1/beacon/states/([^/]+)/sync_committees$",
+                        lambda m: api.get_sync_committees(m.group(1)),
+                    ),
+                    (
+                        r"^/eth/v1/beacon/blocks/([^/]+)/root$",
+                        lambda m: api.get_block_root(m.group(1)),
+                    ),
+                    (
+                        r"^/eth/v1/beacon/blocks/([^/]+)/attestations$",
+                        lambda m: api.get_block_attestations(m.group(1)),
+                    ),
+                    (
+                        r"^/eth/v1/beacon/pool/voluntary_exits$",
+                        lambda m: api.get_pool_voluntary_exits(),
+                    ),
+                    (
+                        r"^/eth/v1/beacon/pool/proposer_slashings$",
+                        lambda m: api.get_pool_proposer_slashings(),
+                    ),
+                    (
+                        r"^/eth/v1/beacon/pool/attester_slashings$",
+                        lambda m: api.get_pool_attester_slashings(),
+                    ),
+                    (
+                        r"^/eth/v1/validator/sync_committee_contribution$",
+                        lambda m: api.sync_committee_contribution(
+                            int(params["slot"]),
+                            int(params["subcommittee_index"]),
+                            params["beacon_block_root"],
+                        ),
+                    ),
+                    (
+                        r"^/eth/v1/validator/blinded_blocks/(\d+)$",
+                        lambda m: api.produce_blinded_block(
+                            int(m.group(1)), params["randao_reveal"]
+                        ),
+                    ),
+                    (r"^/eth/v1/config/spec$", lambda m: api.get_spec()),
+                    (
+                        r"^/eth/v1/config/fork_schedule$",
+                        lambda m: api.get_fork_schedule(),
+                    ),
+                    (
+                        r"^/eth/v1/config/deposit_contract$",
+                        lambda m: api.get_deposit_contract(),
+                    ),
+                    (
+                        r"^/eth/v2/debug/beacon/states/([^/]+)$",
+                        lambda m: api.get_debug_state(m.group(1)),
+                    ),
+                    (
+                        r"^/eth/v1/debug/beacon/heads$",
+                        lambda m: api.get_debug_heads(),
+                    ),
                 ]
                 routes_post = [
                     (
@@ -142,6 +218,36 @@ class BeaconApiServer:
                     (
                         r"^/eth/v1/validator/prepare_beacon_proposer$",
                         lambda m: api.prepare_beacon_proposer(self._body()),
+                    ),
+                    (
+                        r"^/eth/v1/beacon/pool/voluntary_exits$",
+                        lambda m: api.post_pool_voluntary_exits(
+                            self._body()["ssz"]
+                        ),
+                    ),
+                    (
+                        r"^/eth/v1/beacon/pool/sync_committees$",
+                        lambda m: api.post_pool_sync_committees(self._body()),
+                    ),
+                    (
+                        r"^/eth/v1/validator/duties/sync/(\d+)$",
+                        lambda m: api.post_sync_duties(
+                            int(m.group(1)), [int(i) for i in self._body()]
+                        ),
+                    ),
+                    (
+                        r"^/eth/v1/validator/contribution_and_proofs$",
+                        lambda m: api.post_contribution_and_proofs(
+                            self._body()
+                        ),
+                    ),
+                    (
+                        r"^/eth/v1/validator/register_validator$",
+                        lambda m: api.register_validator(self._body()),
+                    ),
+                    (
+                        r"^/eth/v1/beacon/blinded_blocks$",
+                        lambda m: api.post_blinded_block(self._body()["ssz"]),
                     ),
                 ]
 

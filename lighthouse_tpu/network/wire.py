@@ -115,20 +115,29 @@ class _PeerConn:
                         raise
 
     def exchange(self, ftype: int, body: bytes):
-        """Frame out, response frame back on the same stream."""
+        """Frame out, response frame back on the same stream. The redial
+        retry covers ONLY a failed send on a stale cached socket (nothing
+        was delivered); once the request is on the wire, a receive failure
+        raises -- re-sending would execute the rpc twice and burn a second
+        rate-limit token."""
         with self.lock:
             for attempt in (0, 1):
                 try:
                     s = self._get()
                     _send_frame(s, ftype, body)
+                except OSError:
+                    self._drop()
+                    if attempt:
+                        raise
+                    continue
+                try:
                     rtype, resp = _recv_frame(s)
                     if rtype is None:
                         raise OSError("peer closed mid-exchange")
                     return rtype, resp
                 except OSError:
                     self._drop()
-                    if attempt:
-                        raise
+                    raise
 
     def close(self) -> None:
         with self.lock:
@@ -608,18 +617,25 @@ class WireBus:
                 mesh.discard(peer_id)
         if conn is not None:
             conn.close()
-        # backfill meshes from remaining subscribers
+        # backfill meshes from remaining subscribers -- symmetrically
+        # (send GRAFT) and never toward a peer that PRUNEd us
+        grafts = []
         with self._lock:
             for topic, mesh in self._mesh.items():
                 if len(mesh) < self.mesh_degree:
                     candidates = [
                         pid
                         for pid, info in self._peers.items()
-                        if topic in info["topics"] and pid not in mesh
+                        if topic in info["topics"]
+                        and pid not in mesh
+                        and pid not in self._pruned_by.get(topic, ())
                     ]
                     random.shuffle(candidates)
                     for pid in candidates[: self.mesh_degree - len(mesh)]:
                         mesh.add(pid)
+                        grafts.append((pid, topic))
+        for pid, topic in grafts:
+            self._send_graft(pid, topic)
 
     def _msg_id(self, topic: str, data: bytes) -> bytes:
         return hashlib.sha256(topic.encode() + data).digest()[:20]
@@ -687,6 +703,10 @@ class WireBus:
             refuse = False
             with self._lock:
                 if msg["peer_id"] in self._peers:
+                    # a graft IS a subscription signal: without recording
+                    # it, the `mesh & subscribers` send filter would
+                    # silently starve the grafted peer
+                    self._peers[msg["peer_id"]]["topics"].add(topic)
                     mesh = self._mesh.setdefault(topic, set())
                     if msg["peer_id"] in mesh:
                         pass

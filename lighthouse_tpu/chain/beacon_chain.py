@@ -98,7 +98,13 @@ class BeaconChain:
         store.put_chain_item(b"head_state_root", genesis_state_root)
         self.head_root = genesis_root
         self.head_state = clone_state(genesis_state)
-        self._states: dict[bytes, object] = {genesis_root: genesis_state}
+        # bounded snapshot cache over the store (snapshot_cache.rs seat):
+        # membership = every non-finalized block root; only recently-used
+        # states stay materialized, misses replay from store snapshots
+        from .state_cache import StateCache
+
+        self._states = StateCache(store)
+        self._states[genesis_root] = genesis_state
         # backfill anchor (historical_blocks.rs oldest_block_slot): the
         # earliest block this node holds; genesis start = nothing to fill.
         # Persisted so from_store restarts don't re-backfill known history.

@@ -1,0 +1,102 @@
+"""Bounded hot-state cache over the store (reference beacon_chain's
+snapshot cache, snapshot_cache.rs + hot_cold_store.rs:48): the chain no
+longer pins a full materialized BeaconState per non-finalized block.
+
+Dict-shaped (the chain's `_states` seat): membership tracks every
+imported non-finalized block root; only the most recently used
+`capacity` states stay materialized, and a miss reconstructs from the
+store's snapshot + block-replay path (`HotColdDB.get_state`). At the
+500k-validator scale a full state is ~100 MB -- pinning one per block
+of a whole non-finality window is what this cache exists to prevent.
+"""
+
+from __future__ import annotations
+
+import threading
+from collections import OrderedDict
+
+
+class StateCacheError(KeyError):
+    """A KNOWN root whose state could not be rebuilt -- store damage, not
+    an unknown-parent condition; never silently mapped to None."""
+
+
+class StateCache:
+    def __init__(self, store, capacity: int = 16):
+        self.store = store
+        self.capacity = capacity
+        self._roots: set[bytes] = set()  # all imported non-finalized roots
+        self._hot: OrderedDict[bytes, object] = OrderedDict()
+        # the API's ThreadingHTTPServer reads while imports write: the
+        # plain dict this replaced was GIL-atomic per op; the LRU's
+        # check-then-act sequences need a real lock
+        self._lock = threading.RLock()
+
+    # -- dict surface --------------------------------------------------------
+
+    def __contains__(self, block_root: bytes) -> bool:
+        return bytes(block_root) in self._roots
+
+    def __len__(self) -> int:
+        return len(self._roots)
+
+    def keys(self):
+        with self._lock:
+            return list(self._roots)
+
+    def __setitem__(self, block_root: bytes, state) -> None:
+        root = bytes(block_root)
+        with self._lock:
+            self._roots.add(root)
+            self._hot[root] = state
+            self._hot.move_to_end(root)
+            while len(self._hot) > self.capacity:
+                self._hot.popitem(last=False)
+
+    def __delitem__(self, block_root: bytes) -> None:
+        root = bytes(block_root)
+        with self._lock:
+            self._roots.discard(root)
+            self._hot.pop(root, None)
+
+    def get(self, block_root: bytes, default=None):
+        root = bytes(block_root)
+        with self._lock:
+            if root not in self._roots:
+                return default
+            state = self._hot.get(root)
+            if state is not None:
+                self._hot.move_to_end(root)
+                return state
+        # reconstruction (store replay) runs outside the lock
+        state = self._reconstruct(root)
+        self[root] = state
+        return state
+
+    def __getitem__(self, block_root: bytes):
+        state = self.get(block_root)
+        if state is None:
+            raise KeyError(bytes(block_root).hex()[:12])
+        return state
+
+    # -- reconstruction ------------------------------------------------------
+
+    def _reconstruct(self, block_root: bytes):
+        """Cold path: resolve the block's post-state root and rebuild via
+        the store's snapshot + replay machinery. A failure here is store
+        damage for a root we PROMISED membership of -- raise with the
+        diagnostic rather than masquerading as an unknown parent."""
+        state_root = self.store.get_chain_item(
+            b"block_post_state:" + block_root
+        )
+        if state_root is None:
+            raise StateCacheError(
+                f"no post-state mapping for known root "
+                f"{bytes(block_root).hex()[:12]}"
+            )
+        try:
+            return self.store.get_state(state_root)
+        except KeyError as e:
+            raise StateCacheError(
+                f"state replay failed for {bytes(block_root).hex()[:12]}: {e}"
+            ) from e

@@ -135,7 +135,7 @@ def build_beacon_node(args):
             bus.bootstrap((host, int(port)))
             node.network.range_sync()
         node.wire_bus = bus
-    api = BeaconApi(node)
+    api = BeaconApi(node, network=getattr(node, "network", None))
     server = BeaconApiServer(api, port=args.http_port)
     return node, server
 

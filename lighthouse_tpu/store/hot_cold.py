@@ -108,7 +108,10 @@ class HotColdDB:
             raise StoreError(f"unknown state {state_root.hex()[:12]}")
         (slot,) = struct.unpack(">Q", summary[:8])
         block_root = summary[8:]
-        get_block = blocks_by_root or self.get_block
+        # replay may start below the hot/cold split (a non-finalized state
+        # whose snapshot ancestor was migrated): resolve blocks from either
+        # temperature
+        get_block = blocks_by_root or self.get_block_any_temperature
 
         # walk back through blocks until one whose POST-state is stored full
         chain = []

@@ -111,3 +111,34 @@ class TestAltairChain:
         h = make_harness(fork="altair")
         h.extend_chain(4 * SLOTS)
         assert h.finalized_epoch() >= 1
+
+
+class TestStateCache:
+    """VERDICT r3 weak-6: the chain must not pin a materialized state per
+    non-finalized block (reference snapshot_cache.rs + store replay)."""
+
+    def test_materialized_states_bounded_and_reconstructable(self):
+        from lighthouse_tpu.crypto.bls import set_backend
+        from lighthouse_tpu.harness import BeaconChainHarness
+        from lighthouse_tpu.types.presets import MINIMAL
+
+        set_backend("fake")
+        h = BeaconChainHarness(16, MINIMAL, sign=False)
+        cache = h.chain._states
+        roots_in_order = []
+        for slot in range(1, 3 * MINIMAL.slots_per_epoch):
+            roots_in_order.append(h.add_block_at_slot(slot))
+        # membership covers every import; materialization stays bounded
+        assert all(r in cache for r in roots_in_order)
+        assert len(cache._hot) <= cache.capacity < len(roots_in_order)
+
+        # an evicted early state reconstructs bit-exactly via store replay
+        early = roots_in_order[0]
+        assert early not in cache._hot
+        state = cache[early]
+        expected_root = h.chain.store.get_chain_item(
+            b"block_post_state:" + early
+        )
+        assert state.tree_hash_root() == expected_root
+        # and the reconstruction is now hot
+        assert early in cache._hot

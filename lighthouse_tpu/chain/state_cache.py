@@ -69,8 +69,18 @@ class StateCache:
                 self._hot.move_to_end(root)
                 return state
         # reconstruction (store replay) runs outside the lock
-        state = self._reconstruct(root)
-        self[root] = state
+        try:
+            state = self._reconstruct(root)
+        except StateCacheError:
+            with self._lock:
+                if root not in self._roots:
+                    return default  # pruned mid-replay: a benign race
+            raise
+        with self._lock:
+            if root not in self._roots:
+                # pruned while we were replaying: do not resurrect it
+                return default
+            self[root] = state
         return state
 
     def __getitem__(self, block_root: bytes):

@@ -244,6 +244,31 @@ def cmd_am(args):
     if args.am_cmd == "wallet-create":
         w = Wallet.create(args.name, args.password)
         print(w.to_json())
+    elif args.am_cmd == "wallet-recover":
+        from .crypto.keystore import KeystoreError
+
+        if args.mnemonic and args.seed:
+            raise KeystoreError(
+                "wallet-recover takes exactly one of --mnemonic/--seed"
+            )
+        if args.mnemonic:
+            wordlist = None
+            if args.wordlist:
+                with open(args.wordlist) as f:
+                    wordlist = f.read().split()
+            w = Wallet.recover(
+                args.name, args.password,
+                mnemonic=args.mnemonic, wordlist=wordlist,
+            )
+        elif args.seed:
+            try:
+                seed = bytes.fromhex(args.seed.removeprefix("0x"))
+            except ValueError as e:
+                raise KeystoreError(f"bad --seed hex: {e}") from None
+            w = Wallet.recover(args.name, args.password, seed=seed)
+        else:
+            raise KeystoreError("wallet-recover needs --mnemonic or --seed")
+        print(w.to_json())
     elif args.am_cmd == "validator-create":
         with open(args.wallet) as f:
             w = Wallet.from_json(f.read())
@@ -394,9 +419,12 @@ def main(argv=None) -> int:
 
     am = sub.add_parser("am", help="account manager")
     am.add_argument("am_cmd", choices=[
-        "wallet-create", "validator-create",
+        "wallet-create", "wallet-recover", "validator-create",
         "slashing-protection-export", "slashing-protection-import",
     ])
+    am.add_argument("--mnemonic", default=None)
+    am.add_argument("--seed", default=None)
+    am.add_argument("--wordlist", default=None, help="BIP-39 wordlist file")
     am.add_argument("--name", default="wallet")
     am.add_argument("--password", default="")
     am.add_argument("--keystore-password", default="")

@@ -268,6 +268,29 @@ class Wallet:
         self.payload["nextaccount"] = index + 1
         return Keystore.encrypt(sk, keystore_password, path=path)
 
+    @classmethod
+    def recover(
+        cls,
+        name: str,
+        password: str,
+        mnemonic: str | None = None,
+        seed: bytes | None = None,
+        wordlist: list[str] | None = None,
+        passphrase: str = "",
+    ) -> "Wallet":
+        """Rebuild a wallet from its recovery secret (reference
+        account_manager wallet recover, eth2_wallet_manager): either a
+        BIP-39 mnemonic (checksum-verified against `wordlist`) or the raw
+        seed. The recovered wallet derives the SAME validator keys at the
+        same EIP-2334 paths; `nextaccount` restarts at 0 and accounts are
+        re-derived in order."""
+        if (mnemonic is None) == (seed is None):
+            raise KeystoreError("recover needs exactly one of mnemonic/seed")
+        if mnemonic is not None:
+            validate_mnemonic(mnemonic, wordlist)
+            seed = mnemonic_to_seed(mnemonic, passphrase)
+        return cls.create(name, password, seed=seed)
+
     def to_json(self) -> str:
         return json.dumps(self.payload)
 
@@ -276,12 +299,83 @@ class Wallet:
         return cls(json.loads(payload))
 
 
+# -- BIP-39 mechanics (reference eth2_wallet's bip39 dependency) -------------
+# The algorithm (entropy -> checksummed word indices -> PBKDF2-HMAC-SHA512
+# seed) is implemented bit-exactly per the BIP; the 2048-word English list
+# is DATA, injected by callers (load the official english.txt for real
+# interop) with a deterministic placeholder fallback so the mechanics are
+# testable offline.
+
+
+def placeholder_wordlist() -> list[str]:
+    """2048 distinct, prefix-unambiguous tokens. NOT the official BIP-39
+    English list: mnemonics built from it round-trip within this
+    implementation but are not interchangeable with other wallets."""
+    return [f"word{i:04d}" for i in range(2048)]
+
+
+def entropy_to_mnemonic(entropy: bytes, wordlist: list[str] | None = None) -> str:
+    if len(entropy) not in (16, 20, 24, 28, 32):
+        raise KeystoreError("entropy must be 128-256 bits in 32-bit steps")
+    words = wordlist or placeholder_wordlist()
+    if len(words) != 2048:
+        raise KeystoreError("wordlist must hold exactly 2048 words")
+    cs_bits = len(entropy) // 4
+    checksum = hashlib.sha256(entropy).digest()
+    bits = int.from_bytes(entropy, "big")
+    bits = (bits << cs_bits) | (checksum[0] >> (8 - cs_bits))
+    total = len(entropy) * 8 + cs_bits
+    out = []
+    for i in range(total // 11):
+        idx = (bits >> (total - 11 * (i + 1))) & 0x7FF
+        out.append(words[idx])
+    return " ".join(out)
+
+
+def validate_mnemonic(mnemonic: str, wordlist: list[str] | None = None) -> bytes:
+    """Checksum-verify; returns the entropy."""
+    words = wordlist or placeholder_wordlist()
+    if len(words) != 2048:
+        raise KeystoreError("wordlist must hold exactly 2048 words")
+    index = {w: i for i, w in enumerate(words)}
+    parts = mnemonic.split()
+    if len(parts) not in (12, 15, 18, 21, 24):
+        raise KeystoreError(f"bad mnemonic length {len(parts)}")
+    bits = 0
+    for w in parts:
+        if w not in index:
+            raise KeystoreError(f"unknown mnemonic word {w!r}")
+        bits = (bits << 11) | index[w]
+    total = len(parts) * 11
+    cs_bits = total // 33
+    ent_bits = total - cs_bits
+    entropy = (bits >> cs_bits).to_bytes(ent_bits // 8, "big")
+    checksum = bits & ((1 << cs_bits) - 1)
+    expected = hashlib.sha256(entropy).digest()[0] >> (8 - cs_bits)
+    if checksum != expected:
+        raise KeystoreError("mnemonic checksum mismatch")
+    return entropy
+
+
+def mnemonic_to_seed(mnemonic: str, passphrase: str = "") -> bytes:
+    """PBKDF2-HMAC-SHA512, 2048 rounds, salt 'mnemonic'+passphrase, 64
+    bytes (the BIP-39 seed derivation, wordlist-independent)."""
+    return hashlib.pbkdf2_hmac(
+        "sha512",
+        mnemonic.encode("utf-8"),
+        b"mnemonic" + passphrase.encode("utf-8"),
+        2048,
+        dklen=64,
+    )
+
+
 class _SeedCarrier:
-    """Adapter letting Keystore.encrypt wrap a raw 32-byte seed."""
+    """Adapter letting Keystore.encrypt wrap a raw wallet seed (32 bytes
+    from create(); 64 from BIP-39 recovery)."""
 
     def __init__(self, seed: bytes):
-        if len(seed) != 32:
-            raise KeystoreError("wallet seed must be 32 bytes")
+        if not 16 <= len(seed) <= 64:
+            raise KeystoreError("wallet seed must be 16-64 bytes")
         self._seed = seed
 
     def to_bytes(self) -> bytes:

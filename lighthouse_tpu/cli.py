@@ -247,27 +247,26 @@ def cmd_am(args):
     elif args.am_cmd == "wallet-recover":
         from .crypto.keystore import KeystoreError
 
-        if args.mnemonic and args.seed:
-            raise KeystoreError(
-                "wallet-recover takes exactly one of --mnemonic/--seed"
-            )
-        if args.mnemonic:
+        try:
+            if args.seed is not None:
+                seed = bytes.fromhex(args.seed.removeprefix("0x"))
+            else:
+                seed = None
             wordlist = None
             if args.wordlist:
                 with open(args.wordlist) as f:
                     wordlist = f.read().split()
+            # exactly-one-of is enforced by Wallet.recover itself
             w = Wallet.recover(
-                args.name, args.password,
-                mnemonic=args.mnemonic, wordlist=wordlist,
+                args.name,
+                args.password,
+                mnemonic=args.mnemonic,
+                seed=seed,
+                wordlist=wordlist,
+                passphrase=args.passphrase,
             )
-        elif args.seed:
-            try:
-                seed = bytes.fromhex(args.seed.removeprefix("0x"))
-            except ValueError as e:
-                raise KeystoreError(f"bad --seed hex: {e}") from None
-            w = Wallet.recover(args.name, args.password, seed=seed)
-        else:
-            raise KeystoreError("wallet-recover needs --mnemonic or --seed")
+        except (KeystoreError, ValueError, OSError) as e:
+            raise SystemExit(f"wallet-recover: {e}") from None
         print(w.to_json())
     elif args.am_cmd == "validator-create":
         with open(args.wallet) as f:
@@ -425,6 +424,10 @@ def main(argv=None) -> int:
     am.add_argument("--mnemonic", default=None)
     am.add_argument("--seed", default=None)
     am.add_argument("--wordlist", default=None, help="BIP-39 wordlist file")
+    am.add_argument(
+        "--passphrase", default="",
+        help="BIP-39 passphrase the seed was derived with",
+    )
     am.add_argument("--name", default="wallet")
     am.add_argument("--password", default="")
     am.add_argument("--keystore-password", default="")

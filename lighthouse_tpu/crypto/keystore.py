@@ -317,7 +317,7 @@ def placeholder_wordlist() -> list[str]:
 def entropy_to_mnemonic(entropy: bytes, wordlist: list[str] | None = None) -> str:
     if len(entropy) not in (16, 20, 24, 28, 32):
         raise KeystoreError("entropy must be 128-256 bits in 32-bit steps")
-    words = wordlist or placeholder_wordlist()
+    words = wordlist if wordlist is not None else placeholder_wordlist()
     if len(words) != 2048:
         raise KeystoreError("wordlist must hold exactly 2048 words")
     cs_bits = len(entropy) // 4
@@ -334,7 +334,7 @@ def entropy_to_mnemonic(entropy: bytes, wordlist: list[str] | None = None) -> st
 
 def validate_mnemonic(mnemonic: str, wordlist: list[str] | None = None) -> bytes:
     """Checksum-verify; returns the entropy."""
-    words = wordlist or placeholder_wordlist()
+    words = wordlist if wordlist is not None else placeholder_wordlist()
     if len(words) != 2048:
         raise KeystoreError("wordlist must hold exactly 2048 words")
     index = {w: i for i, w in enumerate(words)}
@@ -374,8 +374,9 @@ class _SeedCarrier:
     from create(); 64 from BIP-39 recovery)."""
 
     def __init__(self, seed: bytes):
-        if not 16 <= len(seed) <= 64:
-            raise KeystoreError("wallet seed must be 16-64 bytes")
+        # EIP-2333 master derivation needs >= 32 bytes; BIP-39 seeds are 64
+        if not 32 <= len(seed) <= 64:
+            raise KeystoreError("wallet seed must be 32-64 bytes")
         self._seed = seed
 
     def to_bytes(self) -> bytes:

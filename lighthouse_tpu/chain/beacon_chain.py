@@ -428,6 +428,23 @@ class BeaconChain:
     def head(self):
         return self.head_root, self.head_state
 
+    def state_for_block_root(self, block_root: bytes):
+        """Post-state for ANY known block root: the hot cache first, then
+        store reconstruction -- finalized history included, which is what
+        a weak-subjectivity light-client bootstrap asks for."""
+        state = self._states.get(bytes(block_root))
+        if state is not None:
+            return state
+        state_root = self.store.get_chain_item(
+            b"block_post_state:" + bytes(block_root)
+        )
+        if state_root is None:
+            return None
+        try:
+            return self.store.get_state(state_root)
+        except KeyError:
+            return None
+
     # -- optimistic sync / payload invalidation (fork_revert.rs analogue) ---
 
     def on_invalid_payload(

@@ -1,0 +1,236 @@
+"""Light-client data (reference consensus/types/src/light_client_
+{bootstrap,update,finality_update,optimistic_update}.rs + the
+light_client_bootstrap RPC protocol, rpc/protocol.rs:156): the objects a
+light client needs to trustlessly follow the chain from a weak-
+subjectivity root, built from real states with real merkle branches
+(ssz/merkle_proof.py) over the altair state layout.
+
+Spec generalized indices (light_client_update.rs:11-21): the altair
+BeaconState has 24 fields -> a depth-5 field tree, so
+current_sync_committee (field 22) lives at gindex 54, next_sync_committee
+(field 23) at 55, and finalized_checkpoint.root at 105 (field 20's
+checkpoint subtree, right child). This repo's field order matches the
+spec's, which the tests pin.
+
+NOTE: no `from __future__ import annotations` -- @container consumes
+annotations as live SSZ descriptors (types/containers.py header).
+"""
+
+import functools
+
+from ..ssz import Bytes32, Bytes96, Vector, container, uint64
+from ..ssz.merkle_proof import MerkleTree, verify_merkle_proof
+from ..types import types_for
+from ..types.containers import BeaconBlockHeader
+
+CURRENT_SYNC_COMMITTEE_INDEX = 54
+NEXT_SYNC_COMMITTEE_INDEX = 55
+FINALIZED_ROOT_INDEX = 105
+
+CURRENT_SYNC_COMMITTEE_PROOF_LEN = 5
+NEXT_SYNC_COMMITTEE_PROOF_LEN = 5
+FINALIZED_ROOT_PROOF_LEN = 6
+
+
+class LightClientError(ValueError):
+    pass
+
+
+@functools.lru_cache(maxsize=None)
+def light_client_types(preset):
+    t = types_for(preset)
+
+    @container
+    class LightClientBootstrap:
+        header: BeaconBlockHeader.ssz_type
+        current_sync_committee: t.SyncCommittee.ssz_type
+        current_sync_committee_branch: Vector(
+            Bytes32, CURRENT_SYNC_COMMITTEE_PROOF_LEN
+        )
+
+    @container
+    class LightClientUpdate:
+        attested_header: BeaconBlockHeader.ssz_type
+        next_sync_committee: t.SyncCommittee.ssz_type
+        next_sync_committee_branch: Vector(
+            Bytes32, NEXT_SYNC_COMMITTEE_PROOF_LEN
+        )
+        finalized_header: BeaconBlockHeader.ssz_type
+        finality_branch: Vector(Bytes32, FINALIZED_ROOT_PROOF_LEN)
+        sync_aggregate: t.SyncAggregate.ssz_type
+        signature_slot: uint64
+
+    @container
+    class LightClientFinalityUpdate:
+        attested_header: BeaconBlockHeader.ssz_type
+        finalized_header: BeaconBlockHeader.ssz_type
+        finality_branch: Vector(Bytes32, FINALIZED_ROOT_PROOF_LEN)
+        sync_aggregate: t.SyncAggregate.ssz_type
+        signature_slot: uint64
+
+    @container
+    class LightClientOptimisticUpdate:
+        attested_header: BeaconBlockHeader.ssz_type
+        sync_aggregate: t.SyncAggregate.ssz_type
+        signature_slot: uint64
+
+    from types import SimpleNamespace
+
+    return SimpleNamespace(
+        LightClientBootstrap=LightClientBootstrap,
+        LightClientUpdate=LightClientUpdate,
+        LightClientFinalityUpdate=LightClientFinalityUpdate,
+        LightClientOptimisticUpdate=LightClientOptimisticUpdate,
+    )
+
+
+# -- state merkle branches ----------------------------------------------------
+
+
+def _field_tree(state) -> tuple[MerkleTree, dict[str, int]]:
+    from ..ssz import cached_field_roots
+
+    # the per-instance incremental cache: repeated proof generation (an
+    # unauthenticated req/resp surface) must not re-merkleize the state
+    roots = cached_field_roots(state)
+    return MerkleTree(roots), {
+        n: i for i, (n, _) in enumerate(state.ssz_fields)
+    }
+
+
+def sync_committee_branch(state, which: str = "current") -> list[bytes]:
+    """Depth-5 branch proving (current|next)_sync_committee against the
+    state root (BeaconState::compute_merkle_proof in the reference)."""
+    if not hasattr(state, "current_sync_committee"):
+        raise LightClientError("state predates altair")
+    tree, index = _field_tree(state)
+    return tree.proof(index[f"{which}_sync_committee"])
+
+
+def finality_branch(state) -> list[bytes]:
+    """Depth-6 branch proving finalized_checkpoint.ROOT: one step inside
+    the checkpoint container (sibling = epoch leaf), then the field tree."""
+    from ..ssz import uint64 as u64
+
+    tree, index = _field_tree(state)
+    epoch_leaf = u64.hash_tree_root(state.finalized_checkpoint.epoch)
+    return [epoch_leaf] + tree.proof(index["finalized_checkpoint"])
+
+
+def _header_for(state) -> BeaconBlockHeader:
+    """latest_block_header with the state root filled (the canonical
+    header a state commits to -- from_beacon_state in the reference)."""
+    from ..ssz import cached_root
+
+    hdr = state.latest_block_header
+    state_root = bytes(hdr.state_root)
+    if not any(state_root):
+        state_root = cached_root(state)
+    return BeaconBlockHeader(
+        slot=hdr.slot,
+        proposer_index=hdr.proposer_index,
+        parent_root=bytes(hdr.parent_root),
+        state_root=state_root,
+        body_root=bytes(hdr.body_root),
+    )
+
+
+# -- server-side construction -------------------------------------------------
+
+
+def light_client_bootstrap(state, preset):
+    """LightClientBootstrap::from_beacon_state."""
+    if not hasattr(state, "current_sync_committee"):
+        raise LightClientError("state predates altair")
+    lt = light_client_types(preset)
+    return lt.LightClientBootstrap(
+        header=_header_for(state),
+        current_sync_committee=state.current_sync_committee,
+        current_sync_committee_branch=sync_committee_branch(state, "current"),
+    )
+
+
+def light_client_finality_update(
+    attested_state, finalized_header, sync_aggregate, signature_slot, preset
+):
+    lt = light_client_types(preset)
+    return lt.LightClientFinalityUpdate(
+        attested_header=_header_for(attested_state),
+        finalized_header=finalized_header,
+        finality_branch=finality_branch(attested_state),
+        sync_aggregate=sync_aggregate,
+        signature_slot=signature_slot,
+    )
+
+
+def light_client_optimistic_update(
+    attested_state, sync_aggregate, signature_slot, preset
+):
+    lt = light_client_types(preset)
+    return lt.LightClientOptimisticUpdate(
+        attested_header=_header_for(attested_state),
+        sync_aggregate=sync_aggregate,
+        signature_slot=signature_slot,
+    )
+
+
+def light_client_update(
+    attested_state, finalized_header, sync_aggregate, signature_slot, preset
+):
+    lt = light_client_types(preset)
+    return lt.LightClientUpdate(
+        attested_header=_header_for(attested_state),
+        next_sync_committee=attested_state.next_sync_committee,
+        next_sync_committee_branch=sync_committee_branch(
+            attested_state, "next"
+        ),
+        finalized_header=finalized_header,
+        finality_branch=finality_branch(attested_state),
+        sync_aggregate=sync_aggregate,
+        signature_slot=signature_slot,
+    )
+
+
+# -- client-side verification -------------------------------------------------
+
+
+def verify_bootstrap(bootstrap, trusted_block_root: bytes, preset) -> None:
+    """The light client's install check (spec initialize_light_client_
+    store): the header must BE the trusted root, and the committee must
+    prove into the header's state root."""
+    header_root = bootstrap.header.tree_hash_root()
+    if header_root != bytes(trusted_block_root):
+        raise LightClientError(
+            f"bootstrap header {header_root.hex()[:12]} is not the "
+            f"trusted root {bytes(trusted_block_root).hex()[:12]}"
+        )
+    committee_root = bootstrap.current_sync_committee.tree_hash_root()
+    if not verify_merkle_proof(
+        committee_root,
+        [bytes(h) for h in bootstrap.current_sync_committee_branch],
+        CURRENT_SYNC_COMMITTEE_INDEX,
+        bytes(bootstrap.header.state_root),
+    ):
+        raise LightClientError("sync committee branch does not verify")
+
+
+def verify_finality_branch(update) -> None:
+    """The finality proof inside a (finality) update: finalized header
+    root proven at gindex 105 of the ATTESTED state."""
+    if not verify_merkle_proof(
+        update.finalized_header.tree_hash_root(),
+        [bytes(h) for h in update.finality_branch],
+        FINALIZED_ROOT_INDEX,
+        bytes(update.attested_header.state_root),
+    ):
+        raise LightClientError("finality branch does not verify")
+
+
+def verify_next_committee_branch(update) -> None:
+    if not verify_merkle_proof(
+        update.next_sync_committee.tree_hash_root(),
+        [bytes(h) for h in update.next_sync_committee_branch],
+        NEXT_SYNC_COMMITTEE_INDEX,
+        bytes(update.attested_header.state_root),
+    ):
+        raise LightClientError("next sync committee branch does not verify")

@@ -485,6 +485,71 @@ class BeaconApi:
         root = self.node.publish_blinded_block(signed)
         return {"data": {"root": hexs(root)}}
 
+    # -- light client (beacon/light_client routes + the RPC protocol's
+    #    data source; reference light_client_bootstrap.rs + http_api) -------
+
+    def get_light_client_bootstrap(self, block_root_hex: str) -> dict:
+        from ..chain.light_client import (
+            LightClientError,
+            light_client_bootstrap,
+        )
+
+        root = unhex(block_root_hex)
+        state = self.chain.state_for_block_root(root)
+        if state is None:
+            raise ApiError(404, "unknown block root")
+        try:
+            b = light_client_bootstrap(state, self.chain.preset)
+        except LightClientError as e:
+            raise ApiError(400, str(e)) from None
+        return {"data": {"ssz": hexs(b.as_ssz_bytes())}}
+
+    def _attested_context(self):
+        """(attested_state, sync_aggregate, signature_slot) derived from
+        the head block: its sync aggregate attests its parent."""
+        head_block = self.chain.store.get_block_any_temperature(
+            self.chain.head_root
+        )
+        if head_block is None:
+            raise ApiError(404, "no head block")
+        body = head_block.message.body
+        agg = getattr(body, "sync_aggregate", None)
+        if agg is None:
+            raise ApiError(404, "head predates altair")
+        attested = self.chain._states.get(bytes(head_block.message.parent_root))
+        if attested is None:
+            raise ApiError(404, "attested state unavailable")
+        return attested, agg, int(head_block.message.slot)
+
+    def get_light_client_finality_update(self) -> dict:
+        from ..chain.light_client import light_client_finality_update
+
+        attested, agg, slot = self._attested_context()
+        fin_root = bytes(attested.finalized_checkpoint.root)
+        fin_block = (
+            self.chain.store.get_block_any_temperature(fin_root)
+            if any(fin_root)
+            else None
+        )
+        if fin_block is None:
+            raise ApiError(404, "no finalized block yet")
+        from ..types.containers import header_from_block
+
+        fin_header = header_from_block(fin_block.message)
+        u = light_client_finality_update(
+            attested, fin_header, agg, slot, self.chain.preset
+        )
+        return {"data": {"ssz": hexs(u.as_ssz_bytes())}}
+
+    def get_light_client_optimistic_update(self) -> dict:
+        from ..chain.light_client import light_client_optimistic_update
+
+        attested, agg, slot = self._attested_context()
+        u = light_client_optimistic_update(
+            attested, agg, slot, self.chain.preset
+        )
+        return {"data": {"ssz": hexs(u.as_ssz_bytes())}}
+
     # -- config namespace -----------------------------------------------------
 
     def get_spec(self) -> dict:

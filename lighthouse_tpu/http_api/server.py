@@ -176,6 +176,18 @@ class BeaconApiServer:
                             int(m.group(1)), params["randao_reveal"]
                         ),
                     ),
+                    (
+                        r"^/eth/v1/beacon/light_client/bootstrap/([^/]+)$",
+                        lambda m: api.get_light_client_bootstrap(m.group(1)),
+                    ),
+                    (
+                        r"^/eth/v1/beacon/light_client/finality_update$",
+                        lambda m: api.get_light_client_finality_update(),
+                    ),
+                    (
+                        r"^/eth/v1/beacon/light_client/optimistic_update$",
+                        lambda m: api.get_light_client_optimistic_update(),
+                    ),
                     (r"^/eth/v1/config/spec$", lambda m: api.get_spec()),
                     (
                         r"^/eth/v1/config/fork_schedule$",

@@ -40,6 +40,7 @@ BAN_THRESHOLD = -50
 STATUS_PROTOCOL = "/eth2/beacon_chain/req/status/1"
 BLOCKS_BY_RANGE = "/eth2/beacon_chain/req/beacon_blocks_by_range/1"
 BLOCKS_BY_ROOT = "/eth2/beacon_chain/req/beacon_blocks_by_root/1"
+LIGHT_CLIENT_BOOTSTRAP = "/eth2/beacon_chain/req/light_client_bootstrap/1"
 
 
 class NetworkNode:
@@ -132,6 +133,9 @@ class NetworkNode:
         bus.register_rpc(peer_id, STATUS_PROTOCOL, self._rpc_status)
         bus.register_rpc(peer_id, BLOCKS_BY_RANGE, self._rpc_blocks_by_range)
         bus.register_rpc(peer_id, BLOCKS_BY_ROOT, self._rpc_blocks_by_root)
+        bus.register_rpc(
+            peer_id, LIGHT_CLIENT_BOOTSTRAP, self._rpc_light_client_bootstrap
+        )
 
         from .sync import SyncManager
 
@@ -523,6 +527,22 @@ class NetworkNode:
             if blk is not None:
                 out.append(blk)
         return out
+
+    def _rpc_light_client_bootstrap(self, payload, _peer):
+        """LightClientBootstrap req/resp (rpc/protocol.rs:156): serve the
+        bootstrap for a requested block root."""
+        from ..chain.light_client import (
+            LightClientError,
+            light_client_bootstrap,
+        )
+
+        state = self.chain.state_for_block_root(bytes(payload["root"]))
+        if state is None:
+            raise ValueError("unknown block root")
+        try:
+            return light_client_bootstrap(state, self.chain.preset)
+        except LightClientError as e:
+            raise ValueError(str(e)) from None
 
     # -- sync (sync/manager.rs + range_sync + backfill_sync) ----------------
 

@@ -255,6 +255,8 @@ class WireCodec:
                     roots=tuple(bytes(r) for r in payload["roots"])
                 )
             )
+        if "light_client_bootstrap" in protocol:
+            return compress(bytes(payload["root"]))
         raise ValueError(f"unknown protocol {protocol}")
 
     def decode_request(self, protocol: str, data: bytes):
@@ -266,6 +268,8 @@ class WireCodec:
         if "by_root" in protocol:
             req = BlocksByRootRequest.from_ssz_bytes(decompress(data))
             return {"roots": [bytes(r) for r in req.roots]}
+        if "light_client_bootstrap" in protocol:
+            return {"root": decompress(data)}
         raise ValueError(f"unknown protocol {protocol}")
 
     def encode_response(self, protocol: str, result) -> bytes:
@@ -278,6 +282,8 @@ class WireCodec:
                 head_slot=result["head_slot"],
             )
             return _chunks_encode([_ssz_snappy(msg)])
+        if "light_client_bootstrap" in protocol:
+            return _chunks_encode([_ssz_snappy(result)])
         # block streams: one ssz_snappy chunk per block (ssz_snappy.rs)
         return _chunks_encode([_ssz_snappy(b) for b in result])
 
@@ -292,6 +298,13 @@ class WireCodec:
                 "head_root": bytes(msg.head_root),
                 "head_slot": msg.head_slot,
             }
+        if "light_client_bootstrap" in protocol:
+            from ..chain.light_client import light_client_types
+
+            lt = light_client_types(self.preset)
+            return lt.LightClientBootstrap.from_ssz_bytes(
+                decompress(chunks[0])
+            )
         return [
             decode_block_any_fork(decompress(c), self.preset) for c in chunks
         ]

@@ -35,17 +35,10 @@ class SlasherService:
     def accept_block(self, signed_block) -> None:
         """Reduce an imported block to its signed header (what the slasher
         stores and what a ProposerSlashing carries)."""
-        from ..types.containers import BeaconBlockHeader, SignedBeaconBlockHeader
+        from ..types.containers import SignedBeaconBlockHeader, header_from_block
 
-        block = signed_block.message
         header = SignedBeaconBlockHeader(
-            message=BeaconBlockHeader(
-                slot=block.slot,
-                proposer_index=block.proposer_index,
-                parent_root=bytes(block.parent_root),
-                state_root=bytes(block.state_root),
-                body_root=block.body.tree_hash_root(),
-            ),
+            message=header_from_block(signed_block.message),
             signature=bytes(signed_block.signature),
         )
         self.blocks_seen += 1

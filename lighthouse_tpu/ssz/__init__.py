@@ -15,7 +15,12 @@ from .hash import (  # noqa: F401
     mix_in_length,
     pack_bytes,
 )
-from .cached import CachedRoot, ChunkTreeCache, cached_root  # noqa: F401
+from .cached import (  # noqa: F401
+    CachedRoot,
+    ChunkTreeCache,
+    cached_field_roots,
+    cached_root,
+)
 from .types import (  # noqa: F401
     Bitlist,
     Bitvector,

@@ -269,3 +269,20 @@ def cached_root(obj) -> bytes:
         cache = CachedRoot(desc)
         obj.__dict__["_lh_tree_cache"] = cache
     return cache.root(obj)
+
+
+def cached_field_roots(obj) -> list[bytes]:
+    """Per-field roots through the same per-instance incremental cache as
+    cached_root (merkle-proof generation needs the field layer; computing
+    it fresh would re-merkleize the whole state per proof)."""
+    desc = getattr(obj, "ssz_type", None)
+    if not isinstance(desc, Container):
+        raise TypeError("cached_field_roots needs an @container instance")
+    cache = obj.__dict__.get("_lh_tree_cache")
+    if cache is None or cache.desc is not desc:
+        cache = CachedRoot(desc)
+        obj.__dict__["_lh_tree_cache"] = cache
+    return [
+        cache._field_root(name, t, getattr(obj, name))
+        for name, t in desc.fields
+    ]

@@ -98,6 +98,18 @@ class DepositMessage:
     amount: uint64
 
 
+def header_from_block(message) -> "BeaconBlockHeader":
+    """BeaconBlock(.message) -> its header (body replaced by its root) --
+    shared by the slasher feed, light-client data, and header routes."""
+    return BeaconBlockHeader(
+        slot=message.slot,
+        proposer_index=message.proposer_index,
+        parent_root=bytes(message.parent_root),
+        state_root=bytes(message.state_root),
+        body_root=message.body.tree_hash_root(),
+    )
+
+
 @container
 class ValidatorRegistrationV1:
     """Builder-network validator registration (builder-specs; reference

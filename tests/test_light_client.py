@@ -1,0 +1,223 @@
+"""Light-client data (reference light_client_{bootstrap,update}.rs + the
+light_client_bootstrap RPC): spec generalized indices pinned against this
+repo's state layout, real merkle branches verified against real state
+roots, tamper rejection, SSZ round trips, and the bootstrap served over
+HTTP and req/resp."""
+
+import pytest
+
+from lighthouse_tpu.chain.light_client import (
+    CURRENT_SYNC_COMMITTEE_INDEX,
+    FINALIZED_ROOT_INDEX,
+    NEXT_SYNC_COMMITTEE_INDEX,
+    LightClientError,
+    finality_branch,
+    light_client_bootstrap,
+    light_client_finality_update,
+    light_client_types,
+    light_client_update,
+    sync_committee_branch,
+    verify_bootstrap,
+    verify_finality_branch,
+    verify_next_committee_branch,
+)
+from lighthouse_tpu.crypto.bls import set_backend
+from lighthouse_tpu.harness import BeaconChainHarness
+from lighthouse_tpu.types import ChainSpec, MINIMAL
+
+SLOTS = MINIMAL.slots_per_epoch
+
+
+@pytest.fixture(autouse=True)
+def fake_crypto():
+    set_backend("fake")
+    yield
+    set_backend("jax_tpu")
+
+
+def altair_chain(epochs=1):
+    h = BeaconChainHarness(16, MINIMAL, ChainSpec.interop(altair_fork_epoch=0))
+    h.extend_chain(epochs * SLOTS)
+    return h
+
+
+class TestGeneralizedIndices:
+    def test_spec_indices_match_our_state_layout(self):
+        """light_client_update.rs:11-13 constants derive from the altair
+        field order; a layout drift must fail loudly here."""
+        from lighthouse_tpu.types import types_for
+
+        names = [n for n, _ in types_for(MINIMAL).BeaconStateAltair.ssz_fields]
+        assert len(names) == 24  # depth-5 field tree
+        assert 32 + names.index("current_sync_committee") == CURRENT_SYNC_COMMITTEE_INDEX
+        assert 32 + names.index("next_sync_committee") == NEXT_SYNC_COMMITTEE_INDEX
+        # checkpoint ROOT: right child of the finalized_checkpoint field
+        assert (32 + names.index("finalized_checkpoint")) * 2 + 1 == FINALIZED_ROOT_INDEX
+
+
+class TestBootstrap:
+    def test_bootstrap_verifies_against_block_root(self):
+        h = altair_chain()
+        state = h.chain.head_state
+        b = light_client_bootstrap(state, MINIMAL)
+        # the header root IS the chain's head block root
+        assert b.header.tree_hash_root() == h.chain.head_root
+        verify_bootstrap(b, h.chain.head_root, MINIMAL)
+
+    def test_tampered_committee_rejected(self):
+        h = altair_chain()
+        b = light_client_bootstrap(h.chain.head_state, MINIMAL)
+        pks = list(b.current_sync_committee.pubkeys)
+        pks[0] = b"\x11" * 48
+        b.current_sync_committee.pubkeys = tuple(pks)
+        with pytest.raises(LightClientError, match="branch"):
+            verify_bootstrap(b, h.chain.head_root, MINIMAL)
+
+    def test_wrong_trusted_root_rejected(self):
+        h = altair_chain()
+        b = light_client_bootstrap(h.chain.head_state, MINIMAL)
+        with pytest.raises(LightClientError, match="trusted root"):
+            verify_bootstrap(b, b"\x42" * 32, MINIMAL)
+
+    def test_pre_altair_state_refused(self):
+        h = BeaconChainHarness(16, MINIMAL, ChainSpec.interop())
+        with pytest.raises(LightClientError, match="altair"):
+            light_client_bootstrap(h.chain.head_state, MINIMAL)
+
+
+class TestBranches:
+    def test_branch_lengths_match_spec(self):
+        h = altair_chain()
+        s = h.chain.head_state
+        assert len(sync_committee_branch(s, "current")) == 5
+        assert len(sync_committee_branch(s, "next")) == 5
+        assert len(finality_branch(s)) == 6
+
+    def test_finality_update_round_trip_and_verify(self):
+        h = altair_chain(epochs=4)  # finality reached
+        state = h.chain.head_state
+        fin_root = bytes(state.finalized_checkpoint.root)
+        assert any(fin_root), "chain must have finalized"
+        fin_block = h.chain.store.get_block_any_temperature(fin_root)
+        from lighthouse_tpu.types.containers import header_from_block
+
+        fin_header = header_from_block(fin_block.message)
+        u = light_client_finality_update(
+            state, fin_header, None or _empty_agg(), state.slot + 1, MINIMAL
+        )
+        # round trip
+        lt = light_client_types(MINIMAL)
+        u2 = lt.LightClientFinalityUpdate.from_ssz_bytes(u.as_ssz_bytes())
+        # the attested header commits to the state; rebuild the proof root
+        assert bytes(u2.attested_header.state_root) == state.tree_hash_root()
+        verify_finality_branch(u2)
+        # tampered finalized header fails
+        u2.finalized_header.slot = int(u2.finalized_header.slot) + 1
+        with pytest.raises(LightClientError):
+            verify_finality_branch(u2)
+
+    def test_full_update_next_committee_branch(self):
+        h = altair_chain()
+        state = h.chain.head_state
+        u = light_client_update(
+            state,
+            state.latest_block_header,
+            _empty_agg(),
+            state.slot + 1,
+            MINIMAL,
+        )
+        verify_next_committee_branch(u)
+
+
+def _empty_agg():
+    from lighthouse_tpu.crypto.bls import INFINITY_SIGNATURE
+    from lighthouse_tpu.types import types_for
+
+    agg = types_for(MINIMAL).SyncAggregate.default()
+    agg.sync_committee_signature = INFINITY_SIGNATURE
+    return agg
+
+
+class TestServing:
+    def test_bootstrap_over_http(self):
+        from lighthouse_tpu.http_api import BeaconApi, BeaconApiServer
+        from lighthouse_tpu.http_api.client import BeaconNodeHttpClient
+        from lighthouse_tpu.validator_client import InProcessBeaconNode
+
+        h = altair_chain()
+        server = BeaconApiServer(BeaconApi(InProcessBeaconNode(h.chain)))
+        server.start()
+        try:
+            client = BeaconNodeHttpClient(
+                f"http://127.0.0.1:{server.port}", MINIMAL
+            )
+            root = h.chain.head_root
+            resp = client._get(
+                f"/eth/v1/beacon/light_client/bootstrap/0x{root.hex()}"
+            )
+            lt = light_client_types(MINIMAL)
+            b = lt.LightClientBootstrap.from_ssz_bytes(
+                bytes.fromhex(resp["data"]["ssz"].removeprefix("0x"))
+            )
+            verify_bootstrap(b, root, MINIMAL)
+            # optimistic update route serves too
+            resp = client._get(
+                "/eth/v1/beacon/light_client/optimistic_update"
+            )
+            assert resp["data"]["ssz"].startswith("0x")
+        finally:
+            server.stop()
+
+    def test_bootstrap_over_rpc_bus(self):
+        from lighthouse_tpu.network import NetworkNode
+        from lighthouse_tpu.network.message_bus import MessageBus
+        from lighthouse_tpu.network.node import LIGHT_CLIENT_BOOTSTRAP
+        from lighthouse_tpu.store.hot_cold import HotColdDB
+        from lighthouse_tpu.store.kv import MemoryStore
+        from lighthouse_tpu.chain.beacon_chain import BeaconChain
+        from lighthouse_tpu.state_transition import clone_state
+
+        h = altair_chain()
+        bus = MessageBus()
+        node = NetworkNode("server", h.chain, bus)
+        # a second peer asks for the bootstrap over req/resp
+        store = HotColdDB(MemoryStore(), MINIMAL, h.spec)
+        genesis = h.producer.state
+        other = BeaconChain(store, clone_state(genesis), MINIMAL, h.spec)
+        NetworkNode("client", other, bus)
+        root = h.chain.head_root
+        b = bus.request(
+            "client", "server", LIGHT_CLIENT_BOOTSTRAP, {"root": root}
+        )
+        verify_bootstrap(b, root, MINIMAL)
+
+
+class TestFinalizedBootstrap:
+    def test_bootstrap_for_a_finalized_checkpoint_root(self):
+        """The route's primary use case: a weak-subjectivity root that
+        finalized cycles ago must still be servable via store replay."""
+        h = altair_chain(epochs=5)  # finality advanced repeatedly
+        fin_epoch, fin_root = h.chain.finalized_checkpoint
+        assert fin_epoch >= 2
+        # pick a root OLDER than the current finalized checkpoint: pruned
+        # from the hot cache entirely
+        old_root = None
+        for slot in range(1, (fin_epoch - 1) * SLOTS):
+            blk = h.chain.store.get_block_any_temperature
+            # walk the canonical chain from the finalized block down
+        root = fin_root
+        while True:
+            blk = h.chain.store.get_block_any_temperature(root)
+            if blk is None:
+                break
+            parent = bytes(blk.message.parent_root)
+            if h.chain.store.get_block_any_temperature(parent) is None:
+                break
+            old_root = parent
+            root = parent
+        assert old_root is not None
+        assert old_root not in h.chain._states  # genuinely pruned
+        state = h.chain.state_for_block_root(old_root)
+        assert state is not None
+        b = light_client_bootstrap(state, MINIMAL)
+        verify_bootstrap(b, old_root, MINIMAL)

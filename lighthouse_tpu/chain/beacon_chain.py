@@ -430,20 +430,9 @@ class BeaconChain:
 
     def state_for_block_root(self, block_root: bytes):
         """Post-state for ANY known block root: the hot cache first, then
-        store reconstruction -- finalized history included, which is what
-        a weak-subjectivity light-client bootstrap asks for."""
-        state = self._states.get(bytes(block_root))
-        if state is not None:
-            return state
-        state_root = self.store.get_chain_item(
-            b"block_post_state:" + bytes(block_root)
-        )
-        if state_root is None:
-            return None
-        try:
-            return self.store.get_state(state_root)
-        except KeyError:
-            return None
+        memoized store reconstruction -- finalized history included, which
+        is what a weak-subjectivity light-client bootstrap asks for."""
+        return self._states.get_any(block_root)
 
     # -- optimistic sync / payload invalidation (fork_revert.rs analogue) ---
 

@@ -194,7 +194,7 @@ def light_client_update(
 # -- client-side verification -------------------------------------------------
 
 
-def verify_bootstrap(bootstrap, trusted_block_root: bytes, preset) -> None:
+def verify_bootstrap(bootstrap, trusted_block_root: bytes) -> None:
     """The light client's install check (spec initialize_light_client_
     store): the header must BE the trusted root, and the committee must
     prove into the header's state root."""

@@ -27,6 +27,7 @@ class StateCache:
         self.capacity = capacity
         self._roots: set[bytes] = set()  # all imported non-finalized roots
         self._hot: OrderedDict[bytes, object] = OrderedDict()
+        self._cold: OrderedDict[bytes, object] = OrderedDict()
         # the API's ThreadingHTTPServer reads while imports write: the
         # plain dict this replaced was GIL-atomic per op; the LRU's
         # check-then-act sequences need a real lock
@@ -87,6 +88,29 @@ class StateCache:
         state = self.get(block_root)
         if state is None:
             raise KeyError(bytes(block_root).hex()[:12])
+        return state
+
+    def get_any(self, block_root: bytes):
+        """State for a root regardless of membership: known roots via the
+        hot cache, FINALIZED roots via store reconstruction memoized in a
+        small cold-side LRU (repeated light-client bootstraps for the same
+        deep root must not replay per request)."""
+        root = bytes(block_root)
+        if root in self._roots:
+            return self.get(root)
+        with self._lock:
+            state = self._cold.get(root)
+            if state is not None:
+                self._cold.move_to_end(root)
+                return state
+        try:
+            state = self._reconstruct(root)
+        except StateCacheError:
+            return None
+        with self._lock:
+            self._cold[root] = state
+            while len(self._cold) > 4:
+                self._cold.popitem(last=False)
         return state
 
     # -- reconstruction ------------------------------------------------------

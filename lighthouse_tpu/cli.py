@@ -21,16 +21,15 @@ def _spec_preset(args):
         spec = ChainSpec.interop(
             altair_fork_epoch=args.altair_fork_epoch
         )
-    elif args.network == "minimal":
-        spec = ChainSpec.minimal()
     else:
-        spec = ChainSpec.mainnet()
+        spec = ChainSpec.network(args.network)
     return preset, spec
 
 
 def _add_network_args(p):
     p.add_argument("--network", default="interop",
-                   choices=["interop", "minimal", "mainnet"])
+                   choices=["interop", "minimal", "mainnet", "sepolia",
+                            "prater", "goerli"])
     p.add_argument("--preset", default="minimal",
                    choices=["minimal", "mainnet"])
     p.add_argument("--altair-fork-epoch", type=int, default=None)

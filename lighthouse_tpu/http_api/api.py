@@ -675,6 +675,156 @@ class BeaconApi:
     def get_health(self) -> int:
         return 200 if self.node.is_healthy() else 503
 
+    # -- /lighthouse/* extensions (reference http_api's lighthouse
+    #    namespace: validator-inclusion, block-packing-efficiency,
+    #    database, proto-array, UI endpoints) ------------------------------
+
+    def lighthouse_validator_inclusion(self, epoch: int) -> dict:
+        """Global participation for an epoch (validator_inclusion.rs):
+        active gwei vs the target/head-attesting gwei of the previous
+        epoch, from the head state's participation flags (altair) or
+        pending attestations (phase0)."""
+        from ..state_transition.participation import (
+            TIMELY_HEAD_FLAG_INDEX,
+            TIMELY_TARGET_FLAG_INDEX,
+            has_flag,
+        )
+        from ..types import is_active_validator
+
+        s = self.chain.head_state
+        head_epoch = compute_epoch_at_slot(s.slot, self.chain.preset)
+        # the head state only holds participation for ITS previous epoch;
+        # other epochs would silently return head-relative numbers under
+        # the requested label
+        if epoch != max(head_epoch - 1, 0):
+            raise ApiError(
+                400,
+                f"inclusion data only available for epoch {max(head_epoch - 1, 0)}",
+            )
+        active_gwei = sum(
+            v.effective_balance
+            for v in s.validators
+            if is_active_validator(v, epoch)
+        )
+        target_gwei = 0
+        head_gwei = 0
+        if hasattr(s, "previous_epoch_participation"):
+            part = s.previous_epoch_participation
+            for i, flags in enumerate(part):
+                v = s.validators[i]
+                if v.slashed or not is_active_validator(v, epoch):
+                    continue
+                if has_flag(flags, TIMELY_TARGET_FLAG_INDEX):
+                    target_gwei += v.effective_balance
+                if has_flag(flags, TIMELY_HEAD_FLAG_INDEX):
+                    head_gwei += v.effective_balance
+        else:
+            seen = set()
+            for att in s.previous_epoch_attestations:
+                # phase0: approximate by attester participation
+                seen.add(att.data.target.root)
+            target_gwei = active_gwei if seen else 0
+        return {
+            "data": {
+                "current_epoch_active_gwei": str(active_gwei),
+                "previous_epoch_target_attesting_gwei": str(target_gwei),
+                "previous_epoch_head_attesting_gwei": str(head_gwei),
+            }
+        }
+
+    def lighthouse_database_info(self) -> dict:
+        store = self.chain.store
+        return {
+            "data": {
+                "split_slot": str(store.split_slot),
+                "slots_per_snapshot": str(store.slots_per_snapshot),
+                "anchor_slot": str(self.chain.oldest_block_slot),
+                "head_slot": str(self.chain.head_state.slot),
+                "hot_states_cached": len(self.chain._states._hot),
+                "known_block_roots": len(self.chain._states),
+            }
+        }
+
+    def lighthouse_proto_array(self) -> dict:
+        """The raw fork-choice nodes (reference /lighthouse/proto_array)."""
+        pa = self.chain.fork_choice.proto.proto_array
+        return {
+            "data": [
+                {
+                    "root": hexs(n.root),
+                    "slot": str(n.slot),
+                    "parent": n.parent,
+                    "weight": str(n.weight),
+                    "justified_epoch": str(n.justified_checkpoint[0]),
+                    "finalized_epoch": str(n.finalized_checkpoint[0]),
+                    "execution_status": n.execution_status,
+                    "best_child": n.best_child,
+                    "best_descendant": n.best_descendant,
+                }
+                for n in pa.nodes
+            ]
+        }
+
+    def lighthouse_validator_count(self) -> dict:
+        """UI endpoint: validator registry broken down by status."""
+        s = self.chain.head_state
+        epoch = compute_epoch_at_slot(s.slot, self.chain.preset)
+        counts = {"active_ongoing": 0, "pending": 0, "exited": 0, "slashed": 0}
+        for v in s.validators:
+            if v.slashed:
+                counts["slashed"] += 1
+            elif v.activation_epoch > epoch:
+                counts["pending"] += 1
+            elif epoch < v.exit_epoch:
+                counts["active_ongoing"] += 1
+            else:
+                counts["exited"] += 1
+        return {"data": {k: str(n) for k, n in counts.items()}}
+
+    def lighthouse_block_packing(self, start_slot: int, end_slot: int) -> dict:
+        """Per-block packing efficiency over a canonical slot range
+        (block_packing_efficiency.rs): unique attester coverage each block
+        actually included."""
+        head_slot = int(self.chain.head_state.slot)
+        if end_slot - start_slot > 256 or head_slot - start_slot > 256:
+            # bounds the parent WALK, not just the output: the walk runs
+            # from the head down to start_slot
+            raise ApiError(
+                400, "range too wide (max 256 slots, within 256 of head)"
+            )
+        out = []
+        root = self.chain.head_root
+        blocks = []
+        while root is not None:
+            blk = self.chain.store.get_block_any_temperature(root)
+            if blk is None:
+                break
+            if blk.message.slot < start_slot:
+                break
+            if blk.message.slot <= end_slot:
+                blocks.append((root, blk))
+            parent = bytes(blk.message.parent_root)
+            if not any(parent):
+                break
+            root = parent
+        for root, blk in reversed(blocks):
+            atts = blk.message.body.attestations
+            unique = set()
+            for att in atts:
+                key = att.data.tree_hash_root()
+                for pos, bit in enumerate(att.aggregation_bits):
+                    if bit:
+                        unique.add((key, pos))
+            out.append(
+                {
+                    "slot": str(blk.message.slot),
+                    "block_root": hexs(root),
+                    "attestations_included": len(atts),
+                    "attester_slots_covered": len(unique),
+                }
+            )
+        return {"data": out}
+
     def get_version(self) -> dict:
         return {"data": {"version": API_VERSION}}
 

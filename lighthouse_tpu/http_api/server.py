@@ -205,6 +205,30 @@ class BeaconApiServer:
                         r"^/eth/v1/debug/beacon/heads$",
                         lambda m: api.get_debug_heads(),
                     ),
+                    (
+                        r"^/lighthouse/validator_inclusion/(\d+)/global$",
+                        lambda m: api.lighthouse_validator_inclusion(
+                            int(m.group(1))
+                        ),
+                    ),
+                    (
+                        r"^/lighthouse/database/info$",
+                        lambda m: api.lighthouse_database_info(),
+                    ),
+                    (
+                        r"^/lighthouse/proto_array$",
+                        lambda m: api.lighthouse_proto_array(),
+                    ),
+                    (
+                        r"^/lighthouse/ui/validator_count$",
+                        lambda m: api.lighthouse_validator_count(),
+                    ),
+                    (
+                        r"^/lighthouse/analysis/block_packing$",
+                        lambda m: api.lighthouse_block_packing(
+                            int(params["start_slot"]), int(params["end_slot"])
+                        ),
+                    ),
                 ]
                 routes_post = [
                     (

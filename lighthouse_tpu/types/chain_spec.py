@@ -123,7 +123,74 @@ class ChainSpec:
 
     @classmethod
     def mainnet(cls) -> "ChainSpec":
-        return cls()
+        """Ethereum mainnet (the reference's embedded
+        built_in_network_configs/mainnet bundle)."""
+        return cls(
+            terminal_total_difficulty=58750000000000000000000,
+            deposit_contract_address=bytes.fromhex(
+                "00000000219ab540356cbb839cbe05303d7705fa"
+            ),
+        )
+
+    @classmethod
+    def sepolia(cls) -> "ChainSpec":
+        """Sepolia testnet (built_in_network_configs/sepolia)."""
+        return cls(
+            config_name="sepolia",
+            genesis_fork_version=bytes.fromhex("90000069"),
+            altair_fork_version=bytes.fromhex("90000070"),
+            altair_fork_epoch=50,
+            bellatrix_fork_version=bytes.fromhex("90000071"),
+            bellatrix_fork_epoch=100,
+            min_genesis_time=1655647200,
+            genesis_delay=86400,
+            min_genesis_active_validator_count=1300,
+            terminal_total_difficulty=17000000000000000,
+            deposit_chain_id=11155111,
+            deposit_network_id=11155111,
+            deposit_contract_address=bytes.fromhex(
+                "7f02c3e3c98b133055b8b348b2ac625669ed295d"
+            ),
+        )
+
+    @classmethod
+    def prater(cls) -> "ChainSpec":
+        """Goerli/Prater testnet (built_in_network_configs/prater)."""
+        return cls(
+            config_name="prater",
+            genesis_fork_version=bytes.fromhex("00001020"),
+            altair_fork_version=bytes.fromhex("01001020"),
+            altair_fork_epoch=36660,
+            bellatrix_fork_version=bytes.fromhex("02001020"),
+            bellatrix_fork_epoch=112260,
+            min_genesis_time=1614588812,
+            genesis_delay=1919188,
+            min_genesis_active_validator_count=16384,
+            terminal_total_difficulty=10790000,
+            deposit_chain_id=5,
+            deposit_network_id=5,
+            deposit_contract_address=bytes.fromhex(
+                "ff50ed3d0ec03ac01d4c79aad74928bff48a7b2b"
+            ),
+        )
+
+    @classmethod
+    def network(cls, name: str) -> "ChainSpec":
+        """Embedded per-network bundles (the eth2_network_config seat,
+        common/eth2_network_config/src/lib.rs:33-52)."""
+        table = {
+            "mainnet": cls.mainnet,
+            "sepolia": cls.sepolia,
+            "prater": cls.prater,
+            "goerli": cls.prater,
+            "minimal": cls.minimal,
+            "interop": cls.interop,
+        }
+        if name not in table:
+            raise ValueError(
+                f"unknown network {name!r} (have {sorted(table)})"
+            )
+        return table[name]()
 
     @classmethod
     def minimal(cls) -> "ChainSpec":

@@ -223,3 +223,68 @@ class TestSyncCommitteeOverHttp:
             client.publish_sync_message(msg, subnet)
         finally:
             server.stop()
+
+
+class TestLighthouseExtensions:
+    """/lighthouse/* observability extensions (reference http_api's
+    lighthouse namespace)."""
+
+    def _altair_rig(self):
+        h = BeaconChainHarness(
+            16, MINIMAL, ChainSpec.interop(altair_fork_epoch=0)
+        )
+        node = InProcessBeaconNode(h.chain)
+        server = BeaconApiServer(BeaconApi(node))
+        server.start()
+        client = BeaconNodeHttpClient(
+            f"http://127.0.0.1:{server.port}", MINIMAL
+        )
+        return h, server, client
+
+    def test_validator_inclusion_reflects_participation(self):
+        h, server, client = self._altair_rig()
+        try:
+            h.extend_chain(2 * MINIMAL.slots_per_epoch)
+            # the head state carries participation for ITS previous epoch
+            epoch = 1
+            data = client._get(
+                f"/lighthouse/validator_inclusion/{epoch}/global"
+            )["data"]
+            import pytest as _pytest
+            from lighthouse_tpu.http_api.client import Eth2ClientError
+
+            with _pytest.raises(Eth2ClientError, match="400"):
+                client._get("/lighthouse/validator_inclusion/7/global")
+            active = int(data["current_epoch_active_gwei"])
+            target = int(data["previous_epoch_target_attesting_gwei"])
+            assert active == 16 * 32 * 10**9
+            # full harness participation: everyone attested the target
+            assert target == active
+        finally:
+            server.stop()
+
+    def test_database_info_and_validator_count(self, rig):
+        h, node, server, client = rig
+        h.extend_chain(3)
+        info = client._get("/lighthouse/database/info")["data"]
+        assert int(info["head_slot"]) == 3
+        assert info["known_block_roots"] >= 4
+        counts = client._get("/lighthouse/ui/validator_count")["data"]
+        assert counts["active_ongoing"] == "16"
+
+    def test_proto_array_dump(self, rig):
+        h, node, server, client = rig
+        h.extend_chain(2)
+        nodes = client._get("/lighthouse/proto_array")["data"]
+        assert len(nodes) >= 3
+        assert any(n["root"] == "0x" + h.chain.head_root.hex() for n in nodes)
+
+    def test_block_packing_analysis(self, rig):
+        h, node, server, client = rig
+        h.extend_chain(6)
+        rows = client._get(
+            "/lighthouse/analysis/block_packing?start_slot=2&end_slot=6"
+        )["data"]
+        assert len(rows) == 5
+        # harness blocks include full-participation attestations
+        assert all(int(r["attester_slots_covered"]) > 0 for r in rows[1:])

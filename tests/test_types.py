@@ -110,3 +110,43 @@ class TestDomains:
 
     def test_epoch_math(self):
         assert compute_epoch_at_slot(17, MINIMAL) == 2
+
+
+class TestNetworkConfigs:
+    """Embedded per-network bundles (the eth2_network_config seat): the
+    published protocol constants for mainnet/sepolia/prater."""
+
+    def test_mainnet(self):
+        from lighthouse_tpu.types import ChainSpec
+
+        s = ChainSpec.network("mainnet")
+        assert s.terminal_total_difficulty == 58750000000000000000000
+        assert s.altair_fork_epoch == 74240
+        assert s.bellatrix_fork_epoch == 144896
+        assert s.deposit_contract_address.hex().startswith("00000000219ab540")
+
+    def test_sepolia(self):
+        from lighthouse_tpu.types import ChainSpec
+
+        s = ChainSpec.network("sepolia")
+        assert s.genesis_fork_version.hex() == "90000069"
+        assert s.deposit_chain_id == 11155111
+        assert s.min_genesis_active_validator_count == 1300
+        assert s.fork_name_at_epoch(100) == "bellatrix"
+
+    def test_prater_aka_goerli(self):
+        from lighthouse_tpu.types import ChainSpec
+
+        assert (
+            ChainSpec.network("prater").genesis_fork_version
+            == ChainSpec.network("goerli").genesis_fork_version
+            == bytes.fromhex("00001020")
+        )
+
+    def test_unknown_network_rejected(self):
+        import pytest as _pytest
+
+        from lighthouse_tpu.types import ChainSpec
+
+        with _pytest.raises(ValueError, match="unknown network"):
+            ChainSpec.network("atlantis")

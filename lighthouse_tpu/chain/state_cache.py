@@ -45,6 +45,10 @@ class StateCache:
         with self._lock:
             return list(self._roots)
 
+    def hot_count(self) -> int:
+        with self._lock:
+            return len(self._hot)
+
     def __setitem__(self, block_root: bytes, state) -> None:
         root = bytes(block_root)
         with self._lock:
@@ -97,7 +101,11 @@ class StateCache:
         deep root must not replay per request)."""
         root = bytes(block_root)
         if root in self._roots:
-            return self.get(root)
+            state = self.get(root)
+            if state is not None:
+                return state
+            # pruned between the membership check and the fetch: fall
+            # through to store reconstruction like any finalized root
         with self._lock:
             state = self._cold.get(root)
             if state is not None:

@@ -719,11 +719,35 @@ class BeaconApi:
                 if has_flag(flags, TIMELY_HEAD_FLAG_INDEX):
                     head_gwei += v.effective_balance
         else:
-            seen = set()
-            for att in s.previous_epoch_attestations:
-                # phase0: approximate by attester participation
-                seen.add(att.data.target.root)
-            target_gwei = active_gwei if seen else 0
+            # phase0: real attester sets from the pending attestations
+            from ..state_transition.per_epoch import (
+                _attesting_indices,
+                _matching_head_attestations,
+                _matching_target_attestations,
+            )
+
+            cache_map: dict = {}
+            prev = max(epoch, 0)
+            target_idx = _attesting_indices(
+                s,
+                _matching_target_attestations(s, prev, self.chain.preset),
+                self.chain.preset,
+                self.chain.spec,
+                cache_map,
+            )
+            head_idx = _attesting_indices(
+                s,
+                _matching_head_attestations(s, prev, self.chain.preset),
+                self.chain.preset,
+                self.chain.spec,
+                cache_map,
+            )
+            target_gwei = sum(
+                s.validators[i].effective_balance for i in target_idx
+            )
+            head_gwei = sum(
+                s.validators[i].effective_balance for i in head_idx
+            )
         return {
             "data": {
                 "current_epoch_active_gwei": str(active_gwei),
@@ -740,7 +764,7 @@ class BeaconApi:
                 "slots_per_snapshot": str(store.slots_per_snapshot),
                 "anchor_slot": str(self.chain.oldest_block_slot),
                 "head_slot": str(self.chain.head_state.slot),
-                "hot_states_cached": len(self.chain._states._hot),
+                "hot_states_cached": self.chain._states.hot_count(),
                 "known_block_roots": len(self.chain._states),
             }
         }

@@ -180,6 +180,27 @@ def sq(a: jnp.ndarray) -> jnp.ndarray:
     return mul(a, a)
 
 
+# Optional fused Pallas path (pallas_kernels.py): same math in one kernel
+# per block. Opt-in -- the XLA formulation above measured fastest on v5e,
+# so the switch exists for per-generation tuning, not as the default.
+# COVERAGE: only plain Fp mul/sq switch; the Fp2 Karatsuba in tower.py
+# keeps the XLA column path deliberately (its column-domain sharing adds
+# three raw column vectors BEFORE one reduction -- a fused mul-with-
+# reduction kernel cannot express that without giving the sharing up).
+import os as _os  # noqa: E402
+
+if _os.environ.get("LIGHTHOUSE_TPU_PALLAS") == "1":  # pragma: no cover
+    def mul(a: jnp.ndarray, b: jnp.ndarray) -> jnp.ndarray:  # noqa: F811
+        from .pallas_kernels import fp_mul
+
+        return fp_mul(a, b)
+
+    def sq(a: jnp.ndarray) -> jnp.ndarray:  # noqa: F811
+        from .pallas_kernels import fp_mul
+
+        return fp_mul(a, a)
+
+
 def _norm(x: jnp.ndarray) -> jnp.ndarray:
     """Renormalize small-column results (|entries| < 2^31, |value| < 2^399)
     back to the lazy invariant."""

@@ -1,0 +1,112 @@
+"""Pallas TPU kernels for the hot Fp ops (optional fast path).
+
+The XLA formulation in limbs.py (Toeplitz gather + dot_general + einsum
+folds) measured fastest on v5e in earlier rounds, so it stays the
+default; this module provides the same math as ONE fused Pallas kernel --
+product columns, the carry rounds, and both modular folds execute in a
+single VMEM residency per block instead of XLA-scheduled HLO ops, which
+is the classic fusion win when HBM bandwidth, not FLOPs, bounds the op.
+
+Enable with LIGHTHOUSE_TPU_PALLAS=1 (limbs.mul/sq switch over); off-TPU
+backends run the kernel in interpreter mode, which the differential tests
+use to pin bit-exactness against the XLA path and the big-int oracle.
+
+The kernel reuses limbs.py's own jnp reduction helpers INSIDE the kernel
+body -- Pallas traces them like any jax code -- so the two paths cannot
+drift: same carry schedule, same fold matrix, same truncation.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+
+from . import limbs as L
+
+W = L.W
+BLOCK_ROWS = 256  # batch rows per VMEM block (256x35 int32 ~ 35 KB/operand)
+
+
+def _fold_round(x, fold_r):
+    """limbs._fold_round with the constant matrix passed as a kernel
+    input (Pallas requires captured constants to be explicit operands)."""
+    lo = x[..., : L.NLIMBS]
+    hi = x[..., L.NLIMBS :]
+    acc = lo + jnp.einsum(
+        "...j,jk->...k",
+        hi,
+        fold_r[: hi.shape[-1], : L.NLIMBS],
+        preferred_element_type=jnp.int32,
+    )
+    return L.carry3(acc)
+
+
+def _mul_kernel(a_ref, b_ref, fold_ref, out_ref):
+    """One block: (B, W) x (B, W) -> (B, W) lazy limbs, fully fused."""
+    a = a_ref[:]
+    b = b_ref[:]
+    fold_r = fold_ref[:]
+    rows = a.shape[0]
+    cols = jnp.zeros((rows, 2 * W - 1), jnp.int32)
+    # static schoolbook unroll: cols[i + j] += a[i] * b[j] for all j at
+    # once -- W shifted multiply-adds on the VPU (the Toeplitz gather of
+    # the XLA path expresses the same contraction for the MXU)
+    for i in range(W):
+        cols = cols.at[:, i : i + W].add(a[:, i : i + 1] * b)
+    # the exact reduction pipeline from limbs.mul (carry3 + 2 folds +
+    # truncate), with the fold matrix threaded through
+    x = L.carry3(cols)
+    x = _fold_round(x, fold_r)
+    x = _fold_round(x, fold_r)
+    out_ref[:] = x[..., :W]
+
+
+@functools.lru_cache(maxsize=None)
+def _mul_call(interpret: bool):
+    fold_shape = tuple(L.FOLD_R.shape)
+
+    @jax.jit
+    def call(a2: jnp.ndarray, b2: jnp.ndarray) -> jnp.ndarray:
+        n = a2.shape[0]
+        grid = (n // BLOCK_ROWS,)
+        return pl.pallas_call(
+            _mul_kernel,
+            out_shape=jax.ShapeDtypeStruct((n, W), jnp.int32),
+            grid=grid,
+            in_specs=[
+                pl.BlockSpec((BLOCK_ROWS, W), lambda i: (i, 0)),
+                pl.BlockSpec((BLOCK_ROWS, W), lambda i: (i, 0)),
+                # the fold matrix: same full block for every grid step
+                pl.BlockSpec(fold_shape, lambda i: (0, 0)),
+            ],
+            out_specs=pl.BlockSpec((BLOCK_ROWS, W), lambda i: (i, 0)),
+            interpret=interpret,
+        )(a2, b2, L.FOLD_R)
+
+    return call
+
+
+def fp_mul(a: jnp.ndarray, b: jnp.ndarray) -> jnp.ndarray:
+    """Drop-in for limbs.mul: lazy limbs in, lazy limbs out, any leading
+    batch shape. Rows are padded to the block size (pad rows are zeros:
+    valid lazy limbs, discarded on return)."""
+    a, b = jnp.broadcast_arrays(a, b)
+    lead = a.shape[:-1]
+    a2 = a.reshape(-1, W)
+    b2 = b.reshape(-1, W)
+    n = a2.shape[0]
+    padded = -(-n // BLOCK_ROWS) * BLOCK_ROWS
+    if padded != n:
+        pad = ((0, padded - n), (0, 0))
+        a2 = jnp.pad(a2, pad)
+        b2 = jnp.pad(b2, pad)
+    interpret = jax.default_backend() != "tpu"
+    out = _mul_call(interpret)(a2, b2)
+    return out[:n].reshape(*lead, W)
+
+
+def fp_sq(a: jnp.ndarray) -> jnp.ndarray:
+    return fp_mul(a, a)

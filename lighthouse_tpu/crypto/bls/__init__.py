@@ -16,6 +16,7 @@ Layers (bottom-up), mirroring the reference's crypto/bls crate boundary
 from .api import (  # noqa: F401
     AggregatePublicKey,
     AggregateSignature,
+    aggregate_verify,
     BlsError,
     INFINITY_PUBLIC_KEY,
     INFINITY_SIGNATURE,

@@ -276,3 +276,18 @@ def verify(signature: Signature, pubkeys, message: bytes) -> bool:
     return verify_signature_sets(
         [SignatureSet.multiple_pubkeys(signature, pubkeys, message)]
     )
+
+
+def aggregate_verify(signature: Signature, pubkeys, messages) -> bool:
+    """ONE aggregate signature over DISTINCT messages (the spec's
+    AggregateVerify; reference generic_aggregate_signature.rs). Not
+    expressible as verify_signature_sets (those carry one signature PER
+    message), so backends implement it directly."""
+    pubkeys = list(pubkeys)
+    messages = [bytes(m) for m in messages]
+    # structural verdicts are pinned HERE so backends cannot drift
+    if len(pubkeys) != len(messages) or not pubkeys:
+        return False
+    if signature.point.inf:
+        return False
+    return _ensure_backend().aggregate_verify(signature, pubkeys, messages)

@@ -45,3 +45,17 @@ def verify_signature_sets(sets, seed=None) -> bool:
         sig_acc = weighted if sig_acc is None else sig_acc + weighted
     pairs.append((-C.g1_generator(), sig_acc))
     return PR.multi_pairing(pairs) == PR.Fp12.one()
+
+
+def aggregate_verify(signature, pubkeys, messages) -> bool:
+    """ONE aggregate signature over DISTINCT messages (reference
+    generic_aggregate_signature.rs aggregate_verify):
+    prod_i e(pk_i, H(m_i)) * e(-g1, sig) == 1."""
+    # structural checks (lengths, empty, infinity) live in the api layer
+    if not C.g2_subgroup_check_psi(signature.point):
+        return False
+    pairs = [
+        (pk.point, hash_to_g2(bytes(m))) for pk, m in zip(pubkeys, messages)
+    ]
+    pairs.append((-C.g1_generator(), signature.point))
+    return PR.multi_pairing(pairs) == PR.Fp12.one()

@@ -6,3 +6,8 @@ from __future__ import annotations
 
 def verify_signature_sets(sets, seed=None) -> bool:
     return all(bool(s.pubkeys) for s in sets)
+
+
+def aggregate_verify(signature, pubkeys, messages) -> bool:
+    """fake_crypto: anything structurally sane (api-layer checks) passes."""
+    return True

@@ -268,6 +268,49 @@ def verify_signature_sets(sets, seed=None) -> bool:
     )
 
 
+def aggregate_verify_body(u, pk_jac, sig_jac, real):
+    """ONE aggregate signature over k distinct messages:
+    prod_i e(pk_i, H(m_i)) * e(-g1, sig) == 1, padded pairs masked."""
+    h = THC.map_to_g2(u)
+    h_aff, h_inf = TC.to_affine_g2(h)
+    pk_aff, pk_inf = TC.to_affine_g1(pk_jac)
+    sig_ok = TC.g2_subgroup_check(sig_jac[None])[0]
+    sig_aff, sig_inf = TC.to_affine_g2(sig_jac[None])
+    p_aff = jnp.concatenate([pk_aff, _neg_g1_gen_aff()[None]], axis=0)
+    p_inf = jnp.concatenate([pk_inf | ~real, jnp.zeros((1,), bool)], axis=0)
+    q_aff = jnp.concatenate([h_aff, sig_aff], axis=0)
+    q_inf = jnp.concatenate([h_inf | ~real, sig_inf], axis=0)
+    ok = TP.multi_pairing_is_one(p_aff, p_inf, q_aff, q_inf)
+    return ok & sig_ok
+
+
+aggregate_verify_jit = jax.jit(aggregate_verify_body)
+
+
+def aggregate_verify(signature, pubkeys, messages) -> bool:
+    """Reference generic_aggregate_signature.rs aggregate_verify, on the
+    same kernel primitives as the batch verifier (shared warm shapes for
+    the Miller loop / final exponentiation scans)."""
+    # structural checks (lengths, empty, infinity) live in the api layer
+    k = len(pubkeys)
+    k_b = _bucket(k)
+    u = np.zeros((k_b, 2, 2, W), np.int32)
+    pk = np.broadcast_to(_INF_G1, (k_b, 3, W)).copy()
+    for i, (key, msg) in enumerate(zip(pubkeys, messages)):
+        u[i] = _field_draws_cached(bytes(msg))
+        pk[i] = _pk_limbs(key)
+    real = np.zeros((k_b,), bool)
+    real[:k] = True
+    return bool(
+        aggregate_verify_jit(
+            jnp.asarray(u),
+            jnp.asarray(pk),
+            jnp.asarray(_sig_limbs(signature)),
+            jnp.asarray(real),
+        )
+    )
+
+
 # --- device-resident pubkey table ------------------------------------------
 
 

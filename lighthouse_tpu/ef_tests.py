@@ -246,19 +246,9 @@ def _run_bls_case(case_dir, handler, config, fork) -> CaseResult:
         elif handler == "aggregate_verify":
             pks = [bls.PublicKey.from_bytes(_b(p)) for p in inp["pubkeys"]]
             sig = bls.Signature.from_bytes(_b(inp["signature"]))
-            sets = [
-                bls.SignatureSet.single_pubkey(sig, pk, _b(m))
-                for pk, m in zip(pks, inp["messages"])
-            ]
-            # aggregate_verify is one aggregate over distinct messages:
-            # expressible as a batch iff it splits -- reference handles it
-            # via AggregateSignature::aggregate_verify; our api's batch
-            # semantics require per-set signatures, so verify pairwise
-            got = all(
-                bls.verify(s.signature, s.pubkeys, s.message) for s in sets
-            ) if len(sets) == 1 else None
-            if got is None:
-                return CaseResult(case_dir, True, "skipped (multi-msg agg)")
+            got = bls.aggregate_verify(
+                sig, pks, [_b(m) for m in inp["messages"]]
+            )
         elif handler == "batch_verify":
             sets = []
             for pk_h, m_h, sig_h in zip(

@@ -161,3 +161,50 @@ class TestVerificationRejections:
         other = SecretKey(999).public_key()
         forged = SignatureSet.single_pubkey(s.signature, other, s.message)
         assert not verify_signature_sets([forged], seed=3)
+
+
+@pytest.mark.parametrize("backend", BACKENDS)
+class TestAggregateVerify:
+    """spec AggregateVerify: ONE aggregate signature over DISTINCT
+    messages -- identical verdicts on the oracle and the kernel."""
+
+    def _claim(self, k=2):
+        from lighthouse_tpu.crypto.bls import AggregateSignature
+
+        sks = [SecretKey(50 + i) for i in range(k)]
+        msgs = [bytes([i + 1]) * 32 for i in range(k)]
+        agg = AggregateSignature.aggregate(
+            [sk.sign(m) for sk, m in zip(sks, msgs)]
+        )
+        return agg.to_signature(), [sk.public_key() for sk in sks], msgs
+
+    def test_valid_claim_verifies(self, backend):
+        from lighthouse_tpu.crypto.bls import aggregate_verify
+
+        set_backend(backend)
+        sig, pks, msgs = self._claim()
+        assert aggregate_verify(sig, pks, msgs)
+
+    def test_swapped_messages_fail(self, backend):
+        from lighthouse_tpu.crypto.bls import aggregate_verify
+
+        set_backend(backend)
+        sig, pks, msgs = self._claim()
+        assert not aggregate_verify(sig, pks, list(reversed(msgs)))
+
+    def test_structural_rejections(self, backend):
+        from lighthouse_tpu.crypto.bls import aggregate_verify
+
+        set_backend(backend)
+        sig, pks, msgs = self._claim()
+        assert not aggregate_verify(sig, pks, msgs[:1])  # length mismatch
+        assert not aggregate_verify(sig, [], [])  # empty claim
+        assert not aggregate_verify(Signature.infinity(), pks, msgs)
+
+    def test_non_subgroup_signature_fails(self, backend):
+        from lighthouse_tpu.crypto.bls import aggregate_verify
+
+        set_backend(backend)
+        _, pks, msgs = self._claim()
+        evil = Signature.from_bytes(non_subgroup_g2_bytes())
+        assert not aggregate_verify(evil, pks, msgs)

@@ -161,6 +161,41 @@ def mini_tree(tmp_path_factory):
             "output": False,
         },
     )
+    # aggregate_verify: ONE aggregate over DISTINCT messages
+    av_msgs = [b"\x31" * 32, b"\x32" * 32]
+    av_agg = AggregateSignature.aggregate(
+        [sk1.sign(av_msgs[0]), sk2.sign(av_msgs[1])]
+    )
+    bls_case(
+        "aggregate_verify",
+        "valid",
+        {
+            "input": {
+                "pubkeys": [
+                    "0x" + sk1.public_key().to_bytes().hex(),
+                    "0x" + sk2.public_key().to_bytes().hex(),
+                ],
+                "messages": ["0x" + m.hex() for m in av_msgs],
+                "signature": "0x" + av_agg.to_bytes().hex(),
+            },
+            "output": True,
+        },
+    )
+    bls_case(
+        "aggregate_verify",
+        "swapped_messages",
+        {
+            "input": {
+                "pubkeys": [
+                    "0x" + sk1.public_key().to_bytes().hex(),
+                    "0x" + sk2.public_key().to_bytes().hex(),
+                ],
+                "messages": ["0x" + m.hex() for m in reversed(av_msgs)],
+                "signature": "0x" + av_agg.to_bytes().hex(),
+            },
+            "output": False,
+        },
+    )
     msgs = [b"\x01" * 32, b"\x02" * 32]
     sigs = [sk1.sign(msgs[0]), sk2.sign(msgs[1])]
     bls_case(
@@ -213,7 +248,7 @@ def test_mini_tree_bls_cases_on_jax_backend(mini_tree):
         results = run_tree(mini_tree, configs=("general",))
         failures = [r for r in results if not r.ok]
         assert not failures, failures
-        assert len(results) == 6
+        assert len(results) == 8
     finally:
         set_backend("fake")
 

@@ -54,8 +54,6 @@ class TestPallasMul:
 
 def test_env_switch_rebinds_mul(monkeypatch):
     """LIGHTHOUSE_TPU_PALLAS=1 swaps limbs.mul to the fused kernel."""
-    import importlib
-    import os
     import sys
 
     monkeypatch.setenv("LIGHTHOUSE_TPU_PALLAS", "1")

@@ -198,21 +198,34 @@ def child() -> None:
     from __graft_entry__ import _arm_compilation_cache, _example_batch
 
     _arm_compilation_cache()
-    from lighthouse_tpu.crypto.bls.backends.jax_tpu import verify_jit
+    from lighthouse_tpu.crypto.bls.backends.jax_tpu import verify_device
 
     t0 = time.perf_counter()
     args = _example_batch(n_sets, k_pk, distinct=distinct)
     fixture_s = time.perf_counter() - t0
 
+    # Compile + warm, retried: the remote compile endpoint drops long
+    # requests, but every stage that compiles persists to .jax_cache, so a
+    # retry resumes at the first uncompiled stage (the staged pipeline
+    # exists exactly for this).
     t0 = time.perf_counter()
-    ok = bool(jax.block_until_ready(verify_jit(*args)))  # compile + warm
+    last = None
+    for _ in range(max(1, int(os.environ.get("BENCH_COMPILE_RETRIES", "4")))):
+        try:
+            ok = bool(jax.block_until_ready(verify_device(*args)))
+            last = None
+            break
+        except Exception as exc:  # noqa: BLE001 -- remote compile flake
+            last = exc
+    if last is not None:
+        raise last
     compile_s = time.perf_counter() - t0
     assert ok, "bench batch failed to verify"
 
     times = []
     for _ in range(reps):
         t0 = time.perf_counter()
-        jax.block_until_ready(verify_jit(*args))
+        jax.block_until_ready(verify_device(*args))
         times.append(time.perf_counter() - t0)
     best = min(times)
     sets_per_s = n_sets / best

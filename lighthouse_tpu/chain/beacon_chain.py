@@ -131,6 +131,10 @@ class BeaconChain:
         self.event_sinks: list = []
         # optional per-validator observability (validator_monitor.rs)
         self.validator_monitor = None
+        # attest-to-fresh-block fast path (early_attester_cache.rs)
+        from .early_attester_cache import EarlyAttesterCache
+
+        self.early_attester_cache = EarlyAttesterCache()
 
     def emit(self, kind: str, payload: dict) -> None:
         for sink in self.event_sinks:
@@ -337,6 +341,7 @@ class BeaconChain:
             b"block_post_state:" + block_root, state_root
         )
         self._states[block_root] = state
+        self.early_attester_cache.add(self.preset, block_root, block, state)
 
         with M.BLOCK_FORK_CHOICE_TIMES.time():
             self._fork_choice_import(
@@ -396,6 +401,46 @@ class BeaconChain:
             )
 
     # -- attestations (gossip path) -----------------------------------------
+
+    def produce_attestation_data(self, slot: int, index: int):
+        """AttestationData for (slot, committee index): the early-attester
+        cache serves the just-imported-block case without state access
+        (early_attester_cache.rs); misses derive from the head state (the
+        produce_unaggregated_attestation fallback, beacon_chain.rs)."""
+        data = self.early_attester_cache.try_attest(slot, index, self.preset)
+        if data is not None:
+            return data
+        from ..types.containers import AttestationData, Checkpoint
+        from ..types.helpers import get_block_root_at_slot
+
+        head_root, state = self.head()
+        epoch = compute_epoch_at_slot(slot, self.preset)
+        block_root = (
+            get_block_root_at_slot(state, slot, self.preset)
+            if slot < state.slot
+            else head_root
+        )
+        target_slot = compute_start_slot_at_epoch(epoch, self.preset)
+        target_root = (
+            get_block_root_at_slot(state, target_slot, self.preset)
+            if target_slot < state.slot
+            else block_root
+        )
+        # current-or-future epoch (a lagging head at an epoch boundary is
+        # still "current"): the CURRENT justified checkpoint; only a
+        # genuinely previous-epoch request uses the previous one
+        source = (
+            state.current_justified_checkpoint
+            if epoch >= compute_epoch_at_slot(state.slot, self.preset)
+            else state.previous_justified_checkpoint
+        )
+        return AttestationData(
+            slot=slot,
+            index=index,
+            beacon_block_root=block_root,
+            source=Checkpoint(epoch=source.epoch, root=bytes(source.root)),
+            target=Checkpoint(epoch=epoch, root=target_root),
+        )
 
     def apply_attestation(self, attestation, indexed_indices) -> None:
         """Feed a verified unaggregated/aggregate attestation into fork

@@ -44,7 +44,13 @@ LIGHT_CLIENT_BOOTSTRAP = "/eth2/beacon_chain/req/light_client_bootstrap/1"
 
 
 class NetworkNode:
-    def __init__(self, peer_id: str, chain: BeaconChain, bus: MessageBus):
+    def __init__(
+        self,
+        peer_id: str,
+        chain: BeaconChain,
+        bus: MessageBus,
+        subscribe_all_subnets: bool = True,
+    ):
         self.peer_id = peer_id
         self.chain = chain
         self.bus = bus
@@ -81,12 +87,40 @@ class NetworkNode:
         )
         bus.subscribe(peer_id, self._topic_block, self._on_gossip_block)
         bus.subscribe(peer_id, self._topic_aggregate, self._on_gossip_aggregate)
-        for subnet in range(chain.spec.attestation_subnet_count):
-            bus.subscribe(
-                peer_id,
-                topic_name("beacon_attestation", self.fork_digest, subnet),
-                self._on_gossip_attestation,
+        # attestation subnets: production nodes run the subnet service
+        # (long-lived camping + duty subscriptions,
+        # subnet_service/attestation_subnets.rs); simulators subscribe to
+        # all 64 (the reference's --subscribe-all-subnets flag)
+        self.subnet_service = None
+        self.discovery = None
+        if subscribe_all_subnets:
+            for subnet in range(chain.spec.attestation_subnet_count):
+                bus.subscribe(
+                    peer_id,
+                    topic_name("beacon_attestation", self.fork_digest, subnet),
+                    self._on_gossip_attestation,
+                )
+        else:
+            import hashlib
+
+            from .subnet_service import AttestationSubnetService
+
+            self.subnet_service = AttestationSubnetService(
+                hashlib.sha256(peer_id.encode()).digest(),
+                chain.preset,
+                chain.spec,
+                subscribe_cb=lambda subnet: bus.subscribe(
+                    peer_id,
+                    topic_name("beacon_attestation", self.fork_digest, subnet),
+                    self._on_gossip_attestation,
+                ),
+                unsubscribe_cb=lambda subnet: bus.unsubscribe(
+                    peer_id,
+                    topic_name("beacon_attestation", self.fork_digest, subnet),
+                ),
+                enr_update_cb=None,
             )
+            self.subnet_service.on_slot(chain.head_state.slot)
         self._topic_contribution = topic_name(
             "sync_committee_contribution_and_proof", self.fork_digest
         )
@@ -117,6 +151,8 @@ class NetworkNode:
         bus.subscribe(
             peer_id, self._topic_voluntary_exit, self._on_gossip_voluntary_exit
         )
+        # per-epoch committees_per_slot memo for subnet computation
+        self._committees_per_slot: dict[int, int] = {}
         # dedup for op gossip (observed_operations.rs): insertion-ordered
         # so the oldest half can be shed at the cap (the reference prunes
         # at finalization; a lifetime-unbounded set is a slow leak)
@@ -124,6 +160,17 @@ class NetworkNode:
         self._seen_ops_cap = 8192
         # optional slasher (slasher/service/src/lib.rs); attach_slasher wires it
         self.slasher_service = None
+        # gossip that outran its prerequisites waits here
+        # (work_reprocessing_queue.rs). Deadlines ride the chain's SLOT
+        # clock, not the wall clock, so the one-slot maturity window
+        # advances with simulated time exactly as with real time.
+        from ..processor.reprocess import ReprocessQueue
+
+        sps = chain.spec.seconds_per_slot
+        self.reprocess = ReprocessQueue(
+            delay_s=float(sps),
+            clock=lambda: chain.slot_clock.current_slot() * float(sps),
+        )
         for subnet in range(chain.preset.sync_committee_subnet_count):
             bus.subscribe(
                 peer_id,
@@ -204,10 +251,33 @@ class NetworkNode:
 
         self.slasher_service = SlasherService(slasher, self.op_pool, broadcast)
 
+    def attach_discovery(self, disc) -> None:
+        """Wire a DiscoveryService: subnet-service rotations advertise
+        their long-lived subnets in the node's ENR attnets bits
+        (discovery/enr.rs update flow)."""
+        self.discovery = disc
+        if self.subnet_service is not None:
+            self.subnet_service._enr_update = lambda subnets: (
+                disc.update_local_enr(attnets=subnets)
+            )
+            disc.update_local_enr(
+                attnets=sorted(self.subnet_service.long_lived)
+            )
+
     def on_slot(self) -> None:
         """Per-slot housekeeping (the reference's per-12s slasher batch)."""
         if self.slasher_service is not None:
             self.slasher_service.update()
+        if self.subnet_service is not None:
+            self.subnet_service.on_slot(self.chain.current_slot)
+        # timed second chance for gossip still waiting on a block
+        for queue, item in self.reprocess.poll():
+            self.processor.submit(queue, item)
+
+    def _flush_reprocess(self, block_root: bytes) -> None:
+        """A block imported: release gossip that was waiting for it."""
+        for queue, item in self.reprocess.on_block_imported(block_root):
+            self.processor.submit(queue, item)
 
     # -- operation gossip (verify_operation.rs + observed_operations.rs) ---
 
@@ -376,6 +446,7 @@ class NetworkNode:
         # mesh re-publication happens at the bus; nothing further here
         if self.slasher_service is not None:
             self.slasher_service.accept_block(signed_block)
+        self._flush_reprocess(signed_block.message.tree_hash_root())
 
     def _work_aggregates(self, items) -> None:
         aggs = [a for a, _ in items]
@@ -396,6 +467,13 @@ class NetworkNode:
         for agg, reason in rejected:
             if "signature" in reason or "selection" in reason:
                 self.penalize(sources.get(id(agg), ""))
+            elif "unknown head block" in reason:
+                self.reprocess.defer(
+                    "gossip_aggregate",
+                    (agg, sources.get(id(agg), "")),
+                    bytes(agg.message.aggregate.data.beacon_block_root),
+                    agg.tree_hash_root(),
+                )
 
     def _work_attestations(self, items) -> None:
         atts = [a for a, _ in items]
@@ -412,6 +490,13 @@ class NetworkNode:
         for att, reason in rejected:
             if "signature" in reason:
                 self.penalize(sources.get(id(att), ""))
+            elif "unknown head block" in reason:
+                self.reprocess.defer(
+                    "gossip_attestation",
+                    (att, sources.get(id(att), "")),
+                    bytes(att.data.beacon_block_root),
+                    att.tree_hash_root(),
+                )
 
     def _work_sync_messages(self, items) -> None:
         msgs = [(m, subnet) for m, subnet, _ in items]
@@ -446,6 +531,7 @@ class NetworkNode:
         self.chain.process_block(signed_block)
         if self.slasher_service is not None:
             self.slasher_service.accept_block(signed_block)
+        self._flush_reprocess(signed_block.message.tree_hash_root())
         self.bus.publish(self.peer_id, self._topic_block, signed_block)
 
     def publish_voluntary_exit(self, signed_exit) -> None:
@@ -453,7 +539,41 @@ class NetworkNode:
         self.op_pool.insert_voluntary_exit(signed_exit)
         self.bus.publish(self.peer_id, self._topic_voluntary_exit, signed_exit)
 
-    def publish_attestation(self, attestation, subnet: int = 0) -> None:
+    def subnet_for_attestation(self, attestation) -> int:
+        """The spec subnet for an attestation's (slot, committee index),
+        from the head state's committee count. The count is memoized per
+        epoch (one shuffle, not one per publish), and epochs beyond the
+        head state's computable range clamp to head+1 -- the committee
+        COUNT tracks the active-validator set, which is what a lagging
+        head can still answer."""
+        data = attestation.data
+        epoch = compute_epoch_at_slot(data.slot, self.chain.preset)
+        count = self._committees_per_slot.get(epoch)
+        if count is None:
+            from ..state_transition import ConsensusContext
+
+            state = self.chain.head_state
+            state_epoch = compute_epoch_at_slot(state.slot, self.chain.preset)
+            cache = ConsensusContext(
+                self.chain.preset, self.chain.spec
+            ).committee_cache(state, min(epoch, state_epoch + 1))
+            count = cache.committees_per_slot
+            if len(self._committees_per_slot) > 8:
+                self._committees_per_slot.clear()
+            self._committees_per_slot[epoch] = count
+        from .subnet_service import compute_subnet_for_attestation
+
+        return compute_subnet_for_attestation(
+            count,
+            data.slot,
+            data.index,
+            self.chain.preset,
+            self.chain.spec,
+        )
+
+    def publish_attestation(self, attestation, subnet: int | None = None) -> None:
+        if subnet is None:
+            subnet = self.subnet_for_attestation(attestation)
         self.naive_pool.insert(attestation)
         self.op_pool.insert_attestation(attestation)
         self.bus.publish(

@@ -33,6 +33,11 @@ class HotColdDB:
         # hot snapshot cadence: every epoch by default
         self.slots_per_snapshot = slots_per_snapshot or preset.slots_per_epoch
         self.split_slot = 0  # hot/cold boundary (advances on finality)
+        # schema stamp + open-time migrations (metadata.rs,
+        # schema_change.rs); refuses newer-schema databases
+        from .metadata import ensure_schema
+
+        self.schema_migrations_applied = ensure_schema(kv, preset)
 
     # -- blocks --------------------------------------------------------------
 

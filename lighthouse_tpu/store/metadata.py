@@ -1,0 +1,92 @@
+"""Database schema metadata + migrations (reference
+beacon_node/store/src/metadata.rs CURRENT_SCHEMA_VERSION/SchemaVersion
+and beacon_chain/src/schema_change.rs migrate_schema).
+
+The on-disk schema carries a version stamp in the chain column. On open:
+
+- a fresh database is stamped with the current version;
+- an up-to-date database passes through;
+- an OLDER database runs the registered per-step migrations in order
+  (each step is atomic over the keys it rewrites, mirroring
+  schema_change.rs's per-version match arms);
+- a NEWER database refuses to open (downgrades are not supported --
+  metadata.rs returns SchemaVersionError and the reference node exits).
+
+Schema history:
+  v1 -- blocks stored as raw SSZ with the fork resolved from slot order
+        (the pre-multi-fork layout).
+  v2 -- blocks stored fork-prefixed (`<fork>\\x00<ssz>`), letting the
+        store decode any-fork blocks without a spec lookup (the current
+        layout, hot_cold.py put_block).
+"""
+
+from __future__ import annotations
+
+from .kv import Column
+
+CURRENT_SCHEMA_VERSION = 2
+SCHEMA_VERSION_KEY = b"schema_version"
+
+_KNOWN_FORKS = (b"phase0", b"altair", b"bellatrix")
+
+
+class SchemaVersionError(RuntimeError):
+    pass
+
+
+def get_schema_version(kv) -> int | None:
+    raw = kv.get(Column.CHAIN, SCHEMA_VERSION_KEY)
+    return int.from_bytes(raw, "little") if raw is not None else None
+
+
+def set_schema_version(kv, version: int) -> None:
+    kv.put(Column.CHAIN, SCHEMA_VERSION_KEY, version.to_bytes(8, "little"))
+
+
+def _migrate_v1_to_v2(kv, preset) -> None:
+    """Fork-prefix every stored block. v1 rows hold bare SSZ; phase0 is
+    the only fork that ever shipped v1 databases, so the prefix is
+    constant -- the rewrite is idempotent (already-prefixed rows are
+    left alone, making a crashed half-migration safe to re-run)."""
+    for column in (Column.BLOCK, Column.FREEZER_BLOCK):
+        ops = []
+        for key in list(kv.keys(column)):
+            data = kv.get(column, key)
+            if data is None or data.split(b"\x00", 1)[0] in _KNOWN_FORKS:
+                continue  # already v2
+            ops.append(("put", column, key, b"phase0\x00" + data))
+        kv.do_atomically(ops)
+
+
+MIGRATIONS = {
+    (1, 2): _migrate_v1_to_v2,
+}
+
+
+def ensure_schema(kv, preset) -> list:
+    """Open-time check-and-migrate. Returns the list of applied steps
+    (empty for fresh/up-to-date databases)."""
+    version = get_schema_version(kv)
+    if version is None:
+        set_schema_version(kv, CURRENT_SCHEMA_VERSION)
+        return []
+    if version == CURRENT_SCHEMA_VERSION:
+        return []
+    if version > CURRENT_SCHEMA_VERSION:
+        raise SchemaVersionError(
+            f"database schema v{version} is newer than this build's "
+            f"v{CURRENT_SCHEMA_VERSION}; downgrades are not supported"
+        )
+    applied = []
+    while version < CURRENT_SCHEMA_VERSION:
+        step = (version, version + 1)
+        migration = MIGRATIONS.get(step)
+        if migration is None:
+            raise SchemaVersionError(
+                f"no migration registered for schema v{step[0]} -> v{step[1]}"
+            )
+        migration(kv, preset)
+        version += 1
+        set_schema_version(kv, version)
+        applied.append(step)
+    return applied

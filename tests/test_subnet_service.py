@@ -1,0 +1,141 @@
+"""Attestation subnet service (reference subnet_service/
+attestation_subnets.rs): subnet striping, long-lived deterministic
+subscriptions advertised over discovery, short-lived duty
+subscriptions, and the node wiring."""
+
+import pytest
+
+from lighthouse_tpu.crypto.bls import SecretKey, set_backend
+from lighthouse_tpu.network.subnet_service import (
+    AttestationSubnetService,
+    compute_subnet_for_attestation,
+    compute_subscribed_subnets,
+)
+from lighthouse_tpu.types import ChainSpec, MINIMAL
+
+
+@pytest.fixture(autouse=True)
+def fake_crypto():
+    set_backend("fake")
+    yield
+    set_backend("jax_tpu")
+
+
+SPEC = ChainSpec.interop()
+
+
+def test_subnet_striping():
+    # committees stripe across subnets through the epoch, wrapping at 64
+    per_slot = 4
+    seen = set()
+    for slot in range(MINIMAL.slots_per_epoch):
+        for index in range(per_slot):
+            s = compute_subnet_for_attestation(per_slot, slot, index, MINIMAL, SPEC)
+            assert 0 <= s < SPEC.attestation_subnet_count
+            seen.add(s)
+    # minimal preset: 8 slots x 4 committees = 32 distinct subnets
+    assert len(seen) == 8 * 4
+    # same (slot, index) in a later epoch maps identically
+    a = compute_subnet_for_attestation(4, 3, 2, MINIMAL, SPEC)
+    b = compute_subnet_for_attestation(4, 3 + MINIMAL.slots_per_epoch, 2, MINIMAL, SPEC)
+    assert a == b
+
+
+def test_long_lived_subnets_deterministic_and_rotating():
+    nid = b"\x42" * 32
+    a = compute_subscribed_subnets(nid, epoch=0, spec=SPEC)
+    assert a == compute_subscribed_subnets(nid, epoch=255, spec=SPEC)
+    assert len(a) == 2 and len(set(a)) == 2
+    b = compute_subscribed_subnets(nid, epoch=256, spec=SPEC)
+    assert a != b or compute_subscribed_subnets(nid, 512, SPEC) != a
+    # different nodes camp on different subnets (with high probability)
+    c = compute_subscribed_subnets(b"\x43" * 32, epoch=0, spec=SPEC)
+    assert set(a) != set(c)
+
+
+def test_service_lifecycle():
+    subscribed, unsubscribed, enrs = [], [], []
+    svc = AttestationSubnetService(
+        b"\x01" * 32,
+        MINIMAL,
+        SPEC,
+        subscribe_cb=subscribed.append,
+        unsubscribe_cb=unsubscribed.append,
+        enr_update_cb=enrs.append,
+    )
+    svc.on_slot(0)
+    assert len(svc.long_lived) == 2
+    assert set(subscribed) == svc.long_lived
+    assert enrs == [sorted(svc.long_lived)]
+
+    # duty subscription on a non-long-lived subnet
+    duty_slot = 5
+    subnet = svc.subscribe_for_duty(duty_slot, 4, 1)
+    if subnet not in svc.long_lived:
+        assert subnet in set(subscribed)
+    assert svc.is_subscribed(subnet)
+
+    # the duty slot passes: the short-lived seat is released
+    svc.on_slot(duty_slot + 1)
+    if subnet not in svc.long_lived:
+        assert subnet in unsubscribed
+        assert not svc.is_subscribed(subnet)
+    # long-lived stays
+    assert svc.long_lived <= svc.active_subnets()
+
+    # period rotation re-advertises
+    svc.on_slot(256 * MINIMAL.slots_per_epoch)
+    assert len(enrs) >= 2
+
+
+def test_node_selective_subscription_and_enr():
+    from lighthouse_tpu.chain.beacon_chain import BeaconChain
+    from lighthouse_tpu.network import MessageBus, NetworkNode
+    from lighthouse_tpu.network.discovery import DiscoveryService
+    from lighthouse_tpu.network.message_bus import topic_name
+    from lighthouse_tpu.store.hot_cold import HotColdDB
+    from lighthouse_tpu.store.kv import MemoryStore
+    from lighthouse_tpu.types import interop_genesis_state
+
+    genesis = interop_genesis_state(64, MINIMAL, SPEC)
+    bus = MessageBus()
+    chain = BeaconChain(
+        HotColdDB(MemoryStore(), MINIMAL, SPEC), genesis, MINIMAL, SPEC
+    )
+    node = NetworkNode("n0", chain, bus, subscribe_all_subnets=False)
+    svc = node.subnet_service
+    assert svc is not None and len(svc.active_subnets()) == 2
+
+    # only subscribed subnet topics are live on the bus
+    on = [
+        s
+        for s in range(SPEC.attestation_subnet_count)
+        if bus.peers_on(topic_name("beacon_attestation", node.fork_digest, s))
+    ]
+    assert set(on) == svc.active_subnets()
+
+    # a duty subscription opens the new subnet topic
+    target = next(
+        s
+        for s in range(SPEC.attestation_subnet_count)
+        if s not in svc.active_subnets()
+    )
+    # find a (slot, index) mapping to `target` with 4 committees/slot
+    slot, index = next(
+        (s, i)
+        for s in range(1, 1 + MINIMAL.slots_per_epoch)
+        for i in range(4)
+        if compute_subnet_for_attestation(4, s, i, MINIMAL, SPEC) == target
+    )
+    svc.subscribe_for_duty(slot, 4, index)
+    assert bus.peers_on(topic_name("beacon_attestation", node.fork_digest, target))
+
+    # discovery wiring: long-lived subnets land in the ENR attnets bits
+    disc = DiscoveryService(SecretKey(777), verify_sigs=False)
+    try:
+        node.attach_discovery(disc)
+        assert disc.local_enr.seq == 2
+        for s in svc.long_lived:
+            assert disc.local_enr.has_attnet(s)
+    finally:
+        disc.stop()

@@ -173,6 +173,17 @@ def cmd_bn(args):
 
     executor.spawn_loop(tick, "per-slot", node.spec.seconds_per_slot)
     executor.spawn_loop(notifier, "notifier", node.spec.seconds_per_slot)
+    monitoring = None
+    if getattr(args, "monitoring_endpoint", None):
+        from .utils.monitoring import MonitoringService, beacon_node_source
+
+        monitoring = MonitoringService(
+            args.monitoring_endpoint,
+            data_sources={
+                "beacon_node": lambda: beacon_node_source(node.chain)
+            },
+        ).start()
+        log.info("monitoring pushes enabled", endpoint=args.monitoring_endpoint)
     rc = 0
     try:
         executor.wait_shutdown()
@@ -183,6 +194,8 @@ def cmd_bn(args):
     except KeyboardInterrupt:
         executor.shutdown("ctrl-c")
         log.info("shutting down")
+    if monitoring is not None:
+        monitoring.stop()
     server.stop()
     executor.join_all()
     return rc
@@ -384,6 +397,9 @@ def main(argv=None) -> int:
     bn.add_argument("--bootnode", default=None,
                     help="host:port of a bootnode registry to join")
     bn.add_argument("--peer-id", default=None)
+    bn.add_argument("--monitoring-endpoint", default=None,
+                    help="push process/system/chain health JSON here "
+                    "(common/monitoring_api parity)")
     bn.add_argument("--dry-run", action="store_true")
     bn.set_defaults(fn=cmd_bn)
 

@@ -291,6 +291,7 @@ class DiscoveryService:
         self.node_id = self.local_enr.node_id
         self.table = RoutingTable(self.node_id)
         self._waiters: dict[str, list] = {}  # rpc id -> [event, reply]
+        self._ip_votes: dict[str, set] = {}  # observed ip -> voting peers
         self._lock = threading.Lock()
         self._stopped = threading.Event()
         self._thread = threading.Thread(target=self._recv_loop, daemon=True)
@@ -378,10 +379,25 @@ class DiscoveryService:
         if "enr" in reply:
             self._ingest(reply["enr"])
         obs = reply.get("observed")
-        if obs and obs[0] != self.local_enr.ip:
-            # the ip vote: a peer saw us from another address; re-sign so
-            # the table we hand out routes to the reachable address
-            self.update_local_enr(ip=obs[0])
+        if (
+            obs
+            and isinstance(obs, (list, tuple))
+            and isinstance(obs[0], str)
+            and obs[0] != self.local_enr.ip
+        ):
+            # the ip VOTE (discv5 majority rule, not single-reply trust):
+            # re-sign the record only once a SECOND distinct peer reports
+            # the same different address, and only if it parses as an ip
+            # (otherwise one lying/buggy peer rewrites our reachability)
+            try:
+                socket.inet_aton(obs[0])
+            except OSError:
+                return reply
+            voters = self._ip_votes.setdefault(obs[0], set())
+            voters.add(addr)
+            if len(voters) >= 2:
+                self._ip_votes.clear()
+                self.update_local_enr(ip=obs[0])
         return reply
 
     def find_node(self, addr: tuple, distances) -> list:
@@ -416,11 +432,20 @@ class DiscoveryService:
             ][:alpha]
             if not cand:
                 break
+            # the alpha queries of a round run CONCURRENTLY: a round costs
+            # one rpc timeout even when every candidate is dead, not alpha
+            threads = []
             for enr in cand:
                 asked.add(enr.node_id)
                 d = log2_distance(enr.node_id, target)
                 ds = sorted({max(1, d - 1), d, min(256, d + 1)})
-                self.find_node(enr.udp_addr, ds)
+                th = threading.Thread(
+                    target=self.find_node, args=(enr.udp_addr, ds), daemon=True
+                )
+                th.start()
+                threads.append(th)
+            for th in threads:
+                th.join(timeout=self.rpc_timeout + 1.0)
         return self.table.closest(target, K_BUCKET)
 
     def bootstrap(self, boot_addr: tuple) -> int:
@@ -460,50 +485,55 @@ class DiscoveryService:
                 return
             try:
                 msg = json.loads(data)
-            except ValueError:
-                continue
-            t = msg.get("t")
-            if t == "ping":
-                self.stats["pings"] += 1
-                if "enr" in msg:
-                    self._ingest(msg["enr"])
-                self._send(
-                    addr,
-                    {
-                        "t": "pong",
-                        "id": msg.get("id"),
-                        "enr": self.local_enr.to_bytes().hex(),
-                        "enr_seq": self.local_enr.seq,
-                        "observed": [addr[0], addr[1]],
-                    },
-                )
-            elif t == "findnode":
-                self.stats["findnodes"] += 1
-                if "enr" in msg:
-                    self._ingest(msg["enr"])
-                enrs = []
-                for d in msg.get("distances", ())[:8]:
-                    if d == 0:
-                        enrs.append(self.local_enr)
-                        continue
-                    enrs.extend(self.table.at_distance(int(d)))
-                self._send(
-                    addr,
-                    {
-                        "t": "nodes",
-                        "id": msg.get("id"),
-                        "enrs": [
-                            e.to_bytes().hex()
-                            for e in enrs[:MAX_NODES_REPLY]
-                        ],
-                    },
-                )
-            elif t in ("pong", "nodes"):
-                with self._lock:
-                    slot = self._waiters.get(msg.get("id"))
-                if slot is not None:
-                    slot[1] = msg
-                    slot[0].set()
+                if not isinstance(msg, dict):
+                    continue
+                self._dispatch(msg, addr)
+            except Exception:  # noqa: BLE001 -- one bad datagram must
+                continue  # never kill the recv loop (remote DoS otherwise)
+
+    def _dispatch(self, msg: dict, addr: tuple) -> None:
+        t = msg.get("t")
+        if t == "ping":
+            self.stats["pings"] += 1
+            if "enr" in msg:
+                self._ingest(msg["enr"])
+            self._send(
+                addr,
+                {
+                    "t": "pong",
+                    "id": msg.get("id"),
+                    "enr": self.local_enr.to_bytes().hex(),
+                    "enr_seq": self.local_enr.seq,
+                    "observed": [addr[0], addr[1]],
+                },
+            )
+        elif t == "findnode":
+            self.stats["findnodes"] += 1
+            if "enr" in msg:
+                self._ingest(msg["enr"])
+            enrs = []
+            for d in msg.get("distances", ())[:8]:
+                if d == 0:
+                    enrs.append(self.local_enr)
+                    continue
+                enrs.extend(self.table.at_distance(int(d)))
+            self._send(
+                addr,
+                {
+                    "t": "nodes",
+                    "id": msg.get("id"),
+                    "enrs": [
+                        e.to_bytes().hex()
+                        for e in enrs[:MAX_NODES_REPLY]
+                    ],
+                },
+            )
+        elif t in ("pong", "nodes"):
+            with self._lock:
+                slot = self._waiters.get(msg.get("id"))
+            if slot is not None:
+                slot[1] = msg
+                slot[0].set()
 
     def _send(self, addr: tuple, msg: dict) -> None:
         try:

@@ -257,11 +257,8 @@ class NetworkNode:
         (discovery/enr.rs update flow)."""
         self.discovery = disc
         if self.subnet_service is not None:
-            self.subnet_service._enr_update = lambda subnets: (
-                disc.update_local_enr(attnets=subnets)
-            )
-            disc.update_local_enr(
-                attnets=sorted(self.subnet_service.long_lived)
+            self.subnet_service.set_enr_update_cb(
+                lambda subnets: disc.update_local_enr(attnets=subnets)
             )
 
     def on_slot(self) -> None:

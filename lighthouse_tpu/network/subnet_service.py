@@ -92,6 +92,14 @@ class AttestationSubnetService:
     def long_lived(self) -> set:
         return set(self._long_lived)
 
+    def set_enr_update_cb(self, cb) -> None:
+        """Late-wire the ENR advertisement seam (a discovery service
+        attached after construction) and advertise the current set."""
+        self._enr_update = cb
+        if cb is not None and self._long_lived:
+            cb(sorted(self._long_lived))
+            self.stats["enr_updates"] += 1
+
     def active_subnets(self) -> set:
         return set(self._active)
 

@@ -74,40 +74,65 @@ class TokenBucket:
         return False
 
 
-class _PeerConn:
-    """One persistent outbound socket to a peer, serialized by a lock;
-    redials once when the cached socket has died."""
+class PlainChannel:
+    """Unencrypted frame channel over a raw socket -- the same interface
+    SecureSocket (secure.py) exposes, so every wire path talks to ONE
+    channel abstraction and encryption is purely a handshake choice."""
 
-    def __init__(self, host: str, port: int):
+    def __init__(self, sock: socket.socket):
+        self.sock = sock
+        self.peer_pubkey = None
+
+    def send_frame(self, ftype: int, body: bytes) -> None:
+        _send_frame(self.sock, ftype, body)
+
+    def recv_frame(self):
+        return _recv_frame(self.sock)
+
+    def close(self) -> None:
+        try:
+            self.sock.close()
+        except OSError:
+            pass
+
+
+class _PeerConn:
+    """One persistent outbound channel to a peer, serialized by a lock;
+    redials (and re-handshakes, in secure mode) once when the cached
+    connection has died. `wrap` upgrades a fresh socket to a channel."""
+
+    def __init__(self, host: str, port: int, wrap=PlainChannel):
         self.host = host
         self.port = port
+        self.wrap = wrap
         self.lock = threading.Lock()
-        self._sock: socket.socket | None = None
+        self._chan = None
 
-    def _dial(self) -> socket.socket:
+    def _dial(self):
         s = socket.create_connection((self.host, self.port), timeout=10)
         s.settimeout(10)
-        return s
+        try:
+            return self.wrap(s)
+        except OSError:
+            s.close()
+            raise
 
-    def _get(self) -> socket.socket:
-        if self._sock is None:
-            self._sock = self._dial()
-        return self._sock
+    def _get(self):
+        if self._chan is None:
+            self._chan = self._dial()
+        return self._chan
 
     def _drop(self) -> None:
-        if self._sock is not None:
-            try:
-                self._sock.close()
-            except OSError:
-                pass
-            self._sock = None
+        if self._chan is not None:
+            self._chan.close()
+            self._chan = None
 
     def send(self, ftype: int, body: bytes) -> None:
         """Fire-and-forget frame (gossip push)."""
         with self.lock:
             for attempt in (0, 1):
                 try:
-                    _send_frame(self._get(), ftype, body)
+                    self._get().send_frame(ftype, body)
                     return
                 except OSError:
                     self._drop()
@@ -123,15 +148,15 @@ class _PeerConn:
         with self.lock:
             for attempt in (0, 1):
                 try:
-                    s = self._get()
-                    _send_frame(s, ftype, body)
+                    chan = self._get()
+                    chan.send_frame(ftype, body)
                 except OSError:
                     self._drop()
                     if attempt:
                         raise
                     continue
                 try:
-                    rtype, resp = _recv_frame(s)
+                    rtype, resp = chan.recv_frame()
                     if rtype is None:
                         raise OSError("peer closed mid-exchange")
                     return rtype, resp
@@ -411,9 +436,19 @@ class WireBus:
         mesh_degree: int = MESH_DEGREE,
         req_burst: float = 16.0,
         req_rate_per_s: float = 8.0,
+        secure: bool = False,
+        identity_sk=None,
+        authenticate: bool = False,
     ):
         self.codec = WireCodec(preset)
         self.host = host
+        # transport security (the noise seat, secure.py): with secure=True
+        # every connection -- inbound and outbound -- runs the DH handshake
+        # and all frames are encrypted+MACed; authenticate adds BLS
+        # transcript signatures binding the connection to identity keys
+        self.secure = secure
+        self.identity_sk = identity_sk
+        self.authenticate = authenticate
         self.peer_id: str | None = None
         self.port: int | None = None
         self._subs: dict[str, object] = {}  # topic -> handler
@@ -483,21 +518,46 @@ class WireBus:
 
     # -- lifecycle -----------------------------------------------------------
 
+    def _wrap_client(self, sock):
+        if not self.secure:
+            return PlainChannel(sock)
+        from .secure import handshake_initiator
+
+        return handshake_initiator(
+            sock, self.identity_sk, authenticate=self.authenticate
+        )
+
+    def _wrap_server(self, sock):
+        if not self.secure:
+            return PlainChannel(sock)
+        from .secure import handshake_responder
+
+        return handshake_responder(
+            sock, self.identity_sk, authenticate=self.authenticate
+        )
+
     def listen(self, peer_id: str, port: int = 0) -> int:
         self.peer_id = peer_id
         outer = self
 
         class Handler(socketserver.BaseRequestHandler):
             def handle(self):
+                try:
+                    chan = outer._wrap_server(self.request)
+                except OSError:
+                    return  # failed/mismatched handshake: drop the dial
                 # the quota is keyed to the CONNECTION, not a requester id
                 # copied from the request body -- ids are free to rotate,
                 # re-dialing costs the flooder a handshake per bucket
                 bucket = TokenBucket(outer.req_burst, outer.req_rate_per_s)
                 while True:
-                    ftype, body = _recv_frame(self.request)
+                    try:
+                        ftype, body = chan.recv_frame()
+                    except OSError:
+                        return  # MAC/sequence failure: kill the stream
                     if ftype is None:
                         return
-                    outer._handle_frame(self.request, ftype, body, bucket)
+                    outer._handle_frame(chan, ftype, body, bucket)
 
         self._server = socketserver.ThreadingTCPServer(
             (self.host, port), Handler, bind_and_activate=True
@@ -530,8 +590,9 @@ class WireBus:
         }
         try:
             with socket.create_connection((host, port), timeout=10) as s:
-                _send_frame(s, FRAME_HELLO, json.dumps(hello).encode())
-                ftype, body = _recv_frame(s)
+                chan = self._wrap_client(s)
+                chan.send_frame(FRAME_HELLO, json.dumps(hello).encode())
+                ftype, body = chan.recv_frame()
         except OSError as e:
             raise ConnectionError(f"dial {host}:{port} failed: {e}") from None
         if ftype != FRAME_HELLO:
@@ -618,7 +679,7 @@ class WireBus:
             conn = self._conns.get(peer_id)
             if conn is None:
                 conn = self._conns[peer_id] = _PeerConn(
-                    info["host"], info["port"]
+                    info["host"], info["port"], wrap=self._wrap_client
                 )
             return conn
 
@@ -698,7 +759,7 @@ class WireBus:
                 self._drop_peer(pid)
         return sent
 
-    def _handle_frame(self, sock, ftype: int, body: bytes, bucket=None) -> None:
+    def _handle_frame(self, chan, ftype: int, body: bytes, bucket=None) -> None:
         if ftype == FRAME_HELLO:
             peer = json.loads(body)
             self._record_peer(peer)
@@ -708,7 +769,7 @@ class WireBus:
                 "port": self.port,
                 "topics": sorted(self._subs),
             }
-            _send_frame(sock, FRAME_HELLO, json.dumps(reply).encode())
+            chan.send_frame(FRAME_HELLO, json.dumps(reply).encode())
             return
         if ftype == FRAME_GRAFT:
             msg = json.loads(body)
@@ -811,24 +872,21 @@ class WireBus:
             # over-quota requesters get an error chunk, not service
             if bucket is not None and not bucket.allow():
                 self.stats["requests_rejected"] += 1
-                _send_frame(sock, FRAME_RESP, b"\x01rate limited")
+                chan.send_frame(FRAME_RESP, b"\x01rate limited")
                 return
             handler = self._rpc.get(protocol)
             if handler is None:
-                _send_frame(
-                    sock, FRAME_RESP, b"\x01unknown protocol"
-                )
+                chan.send_frame(FRAME_RESP, b"\x01unknown protocol")
                 return
             try:
                 payload = self.codec.decode_request(protocol, data)
                 result = handler(payload, requester or "remote")
-                _send_frame(
-                    sock,
+                chan.send_frame(
                     FRAME_RESP,
                     b"\x00" + self.codec.encode_response(protocol, result),
                 )
             except Exception as e:  # noqa: BLE001 -- wire boundary
-                _send_frame(
-                    sock, FRAME_RESP, b"\x01" + str(e).encode()[:512]
+                chan.send_frame(
+                    FRAME_RESP, b"\x01" + str(e).encode()[:512]
                 )
             return

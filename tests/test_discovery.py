@@ -218,6 +218,53 @@ def test_bad_signature_rejected_on_ingest():
         svc.stop()
 
 
+def test_malformed_datagrams_do_not_kill_service():
+    """Attacker-shaped packets (bad JSON, wrong field types, unhashable
+    ids) must never stop the recv loop (single-datagram remote DoS)."""
+    import json
+    import socket as sock_mod
+
+    svc = DiscoveryService(_sk(70), verify_sigs=False)
+    probe = DiscoveryService(_sk(71), verify_sigs=False)
+    try:
+        s = sock_mod.socket(sock_mod.AF_INET, sock_mod.SOCK_DGRAM)
+        addr = (svc.host, svc.udp_port)
+        for payload in (
+            b"not json at all",
+            b"[1,2,3]",
+            json.dumps({"t": "findnode", "distances": 5}).encode(),
+            json.dumps({"t": "findnode", "distances": ["x"]}).encode(),
+            json.dumps({"t": "pong", "id": []}).encode(),
+            json.dumps({"t": "ping", "enr": 12345}).encode(),
+        ):
+            s.sendto(payload, addr)
+        s.close()
+        # the service still answers a well-formed ping afterwards
+        assert probe.ping(addr) is not None
+    finally:
+        svc.stop()
+        probe.stop()
+
+
+def test_single_peer_cannot_rewrite_advertised_ip():
+    """One pong claiming a different observed address must NOT re-sign
+    the local record; the ip vote needs a second distinct reporter."""
+    svc = DiscoveryService(_sk(72), verify_sigs=False)
+    try:
+        assert svc.local_enr.seq == 1
+        # stub the transport: every pong claims a lying observed address
+        svc._rpc = lambda addr, msg: {"observed": ["10.6.6.6", 9]}
+        svc.ping(("127.0.0.9", 1))
+        assert svc.local_enr.seq == 1  # one vote: no rewrite
+        svc.ping(("127.0.0.9", 1))  # SAME reporter again
+        assert svc.local_enr.seq == 1
+        svc.ping(("127.0.0.10", 1))  # second distinct reporter
+        assert svc.local_enr.seq == 2
+        assert svc.local_enr.ip == "10.6.6.6"
+    finally:
+        svc.stop()
+
+
 def test_lookup_converges_without_bootnode_links():
     """A chain a->b->c: a only knows b; lookup walks to c."""
     a = DiscoveryService(_sk(60), verify_sigs=False)

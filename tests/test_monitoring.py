@@ -1,0 +1,122 @@
+"""Remote monitoring push (reference common/monitoring_api): payload
+shape, retry/fail-fast transport, and the chain data source."""
+
+import time
+
+import pytest
+
+from lighthouse_tpu.crypto.bls import set_backend
+from lighthouse_tpu.utils.monitoring import (
+    MonitoringError,
+    MonitoringRig,
+    MonitoringService,
+    beacon_node_source,
+    process_metrics,
+    system_metrics,
+)
+
+
+@pytest.fixture(autouse=True)
+def fake_crypto():
+    set_backend("fake")
+    yield
+    set_backend("jax_tpu")
+
+
+def test_metrics_collectors():
+    p = process_metrics()
+    assert p["cpu_process_seconds_total"] > 0
+    assert p["memory_process_bytes"] > 0
+    s = system_metrics()
+    assert s["cpu_cores"] >= 1 and s["disk_total_bytes"] > 0
+
+
+def test_push_and_payload_shape():
+    rig = MonitoringRig().start()
+    try:
+        svc = MonitoringService(
+            rig.url,
+            data_sources={"beacon_node": lambda: {"head_slot": 17}},
+            clock=lambda: 1234.0,
+        )
+        svc.send_once()
+        assert svc.stats["sent"] == 1
+        (body,) = rig.received
+        procs = [r for r in body if r["sub_type"] == "process"]
+        systems = [r for r in body if r["sub_type"] == "system"]
+        assert len(procs) == 1 and len(systems) == 1
+        assert procs[0]["process"] == "beacon_node"
+        assert procs[0]["timestamp_s"] == 1234
+        assert procs[0]["data"]["head_slot"] == 17
+        assert procs[0]["data"]["memory_process_bytes"] > 0
+        assert systems[0]["data"]["cpu_cores"] >= 1
+    finally:
+        rig.stop()
+
+
+def test_transient_failure_retried_hard_failure_raised():
+    rig = MonitoringRig().start()
+    try:
+        svc = MonitoringService(rig.url, backoff_s=0.01)
+        rig.fail_next = 2  # two 503s, third attempt lands
+        svc.send_once()
+        assert svc.stats["sent"] == 1 and len(rig.received) == 1
+
+        rig.reject_all = True  # 401: configuration, no retry
+        with pytest.raises(MonitoringError, match="rejected"):
+            svc.send_once()
+        assert svc.stats["failed"] == 1
+    finally:
+        rig.stop()
+
+
+def test_sick_data_source_still_reports():
+    rig = MonitoringRig().start()
+    try:
+        def boom():
+            raise RuntimeError("head lock poisoned")
+
+        svc = MonitoringService(rig.url, data_sources={"beacon_node": boom})
+        svc.send_once()
+        (body,) = rig.received
+        proc = next(r for r in body if r["sub_type"] == "process")
+        assert "head lock poisoned" in proc["data"]["source_error"]
+        assert proc["data"]["memory_process_bytes"] > 0
+    finally:
+        rig.stop()
+
+
+def test_periodic_loop_and_chain_source():
+    from lighthouse_tpu.chain.beacon_chain import BeaconChain
+    from lighthouse_tpu.store.hot_cold import HotColdDB
+    from lighthouse_tpu.store.kv import MemoryStore
+    from lighthouse_tpu.types import ChainSpec, MINIMAL, interop_genesis_state
+
+    spec = ChainSpec.interop()
+    chain = BeaconChain(
+        HotColdDB(MemoryStore(), MINIMAL, spec),
+        interop_genesis_state(16, MINIMAL, spec),
+        MINIMAL,
+        spec,
+    )
+    rig = MonitoringRig().start()
+    svc = MonitoringService(
+        rig.url,
+        data_sources={"beacon_node": lambda: beacon_node_source(chain)},
+        update_period_s=0.05,
+    )
+    try:
+        svc.start()
+        deadline = time.time() + 5
+        while svc.stats["sent"] < 2 and time.time() < deadline:
+            time.sleep(0.02)
+        assert svc.stats["sent"] >= 2
+        proc = next(
+            r for r in rig.received[0] if r["sub_type"] == "process"
+        )
+        assert proc["data"]["validator_count"] == 16
+        assert proc["data"]["is_synced"] == 1
+        assert proc["data"]["finalized_epoch"] == 0
+    finally:
+        svc.stop()
+        rig.stop()

@@ -201,7 +201,7 @@ def child() -> None:
     from lighthouse_tpu.crypto.bls.backends.jax_tpu import verify_device
 
     t0 = time.perf_counter()
-    args = _example_batch(n_sets, k_pk, distinct=distinct)
+    args = _example_batch(n_sets, k_pk, distinct=distinct, dedup=True)
     fixture_s = time.perf_counter() - t0
 
     # Compile + warm, retried: the remote compile endpoint drops long
@@ -239,6 +239,7 @@ def child() -> None:
             "platform": jax.devices()[0].platform,
             "n_sets": n_sets,
             "pubkeys_per_set": k_pk,
+            "distinct_messages": min(distinct, n_sets),
             "fixture_s": round(fixture_s, 2),
             "compile_s": round(compile_s, 2),
             "steady_s": round(best, 4),

@@ -237,10 +237,22 @@ def _stage_final(fprod, flags_ok):
 STAGES = (_stage_hash, _stage_prep, _stage_miller, _stage_final)
 
 
-def verify_device(u, pk_jac, sig_jac, scalars, real):
-    """The staged batch verify: same inputs/outputs as verify_body, chained
-    across the four stage executables (device-resident intermediates)."""
-    h_aff, h_inf = _stage_hash(u)
+def verify_device(u, h_idx, pk_jac, sig_jac, scalars, real):
+    """The staged batch verify, chained across the four stage executables
+    (device-resident intermediates).
+
+    `u` holds field draws for the batch's DISTINCT messages only and
+    `h_idx` (n,) maps each set to its row: gossip batches repeat messages
+    heavily (unaggregated attestations share attestation data -- the whole
+    reason naive_aggregation_pool exists; aggregate batches repeat data
+    across aggregators), and H(m) depends only on m, so hash-to-curve work
+    scales with distinct messages, not sets. The per-set expansion is an
+    eager device gather BETWEEN stages, so the prep/miller/final
+    executables keep their warm per-set shapes regardless of how many
+    distinct messages a batch carries."""
+    h_aff_u, h_inf_u = _stage_hash(u)
+    h_aff = jnp.take(h_aff_u, h_idx, axis=0)
+    h_inf = jnp.take(h_inf_u, h_idx, axis=0)
     rpk_aff, rpk_inf, ssum_aff, ssum_inf, flags_ok = _stage_prep(
         pk_jac, sig_jac, scalars, real
     )
@@ -285,11 +297,23 @@ def verify_signature_sets(sets, seed=None) -> bool:
     n_b = _bucket(n)
     k_b = _bucket(k)
 
-    u = np.zeros((n_b, 2, 2, W), np.int32)
+    # Distinct-message dedup: map each set to a row of the unique-message
+    # draw tensor (hash-to-curve cost scales with distinct messages; see
+    # verify_device). Padded sets point at row 0 -- their pairing
+    # contribution is masked by weight 0 regardless.
+    uniq: dict[bytes, int] = {}
+    h_idx = np.zeros((n_b,), np.int32)
+    for i, s in enumerate(sets):
+        msg = bytes(s.message)
+        h_idx[i] = uniq.setdefault(msg, len(uniq))
+    m_b = _bucket(len(uniq))
+    u = np.zeros((m_b, 2, 2, W), np.int32)
+    for msg, j in uniq.items():
+        u[j] = _field_draws_cached(msg)
+
     sig = np.zeros((n_b, 3, 2, W), np.int32)
     sig[:, 1, 0, 0] = 1  # projective infinity (0, 1, 0) on padded rows
     for i, s in enumerate(sets):
-        u[i] = _field_draws_cached(s.message)
         sig[i] = _sig_limbs(s.signature)
 
     table = _common_table(sets)
@@ -325,14 +349,21 @@ def verify_signature_sets(sets, seed=None) -> bool:
     real = np.zeros((n_b,), bool)
     real[:n] = True
 
-    kernel = (
-        verify_jit
-        if os.environ.get("LIGHTHOUSE_TPU_MONOLITH") == "1"
-        else verify_device
-    )
+    if os.environ.get("LIGHTHOUSE_TPU_MONOLITH") == "1":
+        # the monolithic program takes per-set draws (no dedup axis)
+        return bool(
+            verify_jit(
+                jnp.asarray(u[h_idx]),
+                pk_dev,
+                jnp.asarray(sig),
+                jnp.asarray(scalars),
+                jnp.asarray(real),
+            )
+        )
     return bool(
-        kernel(
+        verify_device(
             jnp.asarray(u),
+            jnp.asarray(h_idx),
             pk_dev,
             jnp.asarray(sig),
             jnp.asarray(scalars),
@@ -341,29 +372,29 @@ def verify_signature_sets(sets, seed=None) -> bool:
     )
 
 
-def aggregate_verify_body(u, pk_jac, sig_jac, real):
-    """ONE aggregate signature over k distinct messages:
-    prod_i e(pk_i, H(m_i)) * e(-g1, sig) == 1, padded pairs masked."""
-    h = THC.map_to_g2(u)
-    h_aff, h_inf = TC.to_affine_g2(h)
+@jax.jit
+def _stage_agg_prep(pk_jac, sig_jac, real):
+    """Aggregate-verify prep: affine pubkeys (padding masked to infinity),
+    signature subgroup check + affine. Small program; the heavy stages
+    are shared with the batch verifier below."""
     pk_aff, pk_inf = TC.to_affine_g1(pk_jac)
     sig_ok = TC.g2_subgroup_check(sig_jac[None])[0]
     sig_aff, sig_inf = TC.to_affine_g2(sig_jac[None])
-    p_aff = jnp.concatenate([pk_aff, _neg_g1_gen_aff()[None]], axis=0)
-    p_inf = jnp.concatenate([pk_inf | ~real, jnp.zeros((1,), bool)], axis=0)
-    q_aff = jnp.concatenate([h_aff, sig_aff], axis=0)
-    q_inf = jnp.concatenate([h_inf | ~real, sig_inf], axis=0)
-    ok = TP.multi_pairing_is_one(p_aff, p_inf, q_aff, q_inf)
-    return ok & sig_ok
-
-
-aggregate_verify_jit = jax.jit(aggregate_verify_body)
+    return pk_aff, pk_inf | ~real, sig_aff, sig_inf, sig_ok
 
 
 def aggregate_verify(signature, pubkeys, messages) -> bool:
-    """Reference generic_aggregate_signature.rs aggregate_verify, on the
-    same kernel primitives as the batch verifier (shared warm shapes for
-    the Miller loop / final exponentiation scans)."""
+    """Reference generic_aggregate_signature.rs aggregate_verify:
+    prod_i e(pk_i, H(m_i)) * e(-g1, sig) == 1.
+
+    Runs through the SAME staged executables as the batch verifier --
+    _stage_miller's pair layout (per-row G1 points + the generator pair,
+    per-row G2 points + one trailing G2 point) is exactly the aggregate
+    pair structure, so only the tiny _stage_agg_prep is unique to this
+    path. This staging is also load-bearing for robustness: the previous
+    monolithic hash+Miller+final program was large enough to crash
+    XLA:CPU's executable serializer when the persistent compile cache
+    tried to store it."""
     # structural checks (lengths, empty, infinity) live in the api layer
     k = len(pubkeys)
     k_b = _bucket(k)
@@ -374,14 +405,15 @@ def aggregate_verify(signature, pubkeys, messages) -> bool:
         pk[i] = _pk_limbs(key)
     real = np.zeros((k_b,), bool)
     real[:k] = True
-    return bool(
-        aggregate_verify_jit(
-            jnp.asarray(u),
-            jnp.asarray(pk),
-            jnp.asarray(_sig_limbs(signature)),
-            jnp.asarray(real),
-        )
+    real_dev = jnp.asarray(real)
+    pk_aff, pk_inf, sig_aff, sig_inf, sig_ok = _stage_agg_prep(
+        jnp.asarray(pk), jnp.asarray(_sig_limbs(signature)), real_dev
     )
+    h_aff, h_inf = _stage_hash(jnp.asarray(u))
+    fprod = _stage_miller(
+        pk_aff, pk_inf, h_aff, h_inf | ~real_dev, sig_aff, sig_inf
+    )
+    return bool(_stage_final(fprod, sig_ok))
 
 
 # --- device-resident pubkey table ------------------------------------------

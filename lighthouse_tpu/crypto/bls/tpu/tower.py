@@ -384,6 +384,58 @@ def fp12_sq(a):
     return jnp.stack([c0, fp6_add(t, t)], axis=-4)
 
 
+def fp12_cyclotomic_sq(a):
+    """Granger-Scott squaring, valid ONLY in the cyclotomic subgroup
+    (where conj == inverse -- everything after the easy part of the final
+    exponentiation). 9 Fp2 squarings in ONE stacked fp2_sq call plus
+    linear combines, vs ~18 Fp2 multiplies for the generic fp12_sq.
+    Verified against the oracle's generic squaring on cyclotomic elements
+    in tests/test_tpu_pairing.py."""
+    x00, x01, x02 = a[..., 0, 0, :, :], a[..., 0, 1, :, :], a[..., 0, 2, :, :]
+    x10, x11, x12 = a[..., 1, 0, :, :], a[..., 1, 1, :, :], a[..., 1, 2, :, :]
+    sq = fp2_sq(
+        jnp.stack(
+            [
+                x11,
+                x00,
+                x02,
+                x10,
+                x12,
+                x01,
+                fp2_add(x11, x00),
+                fp2_add(x02, x10),
+                fp2_add(x12, x01),
+            ],
+            axis=0,
+        )
+    )
+    t0, t1, t2, t3, t4, t5 = sq[0], sq[1], sq[2], sq[3], sq[4], sq[5]
+    t6 = fp2_sub(fp2_sub(sq[6], t0), t1)  # 2 x11 x00
+    t7 = fp2_sub(fp2_sub(sq[7], t2), t3)  # 2 x02 x10
+    t8 = fp2_mul_by_xi(fp2_sub(fp2_sub(sq[8], t4), t5))  # 2 xi x12 x01
+    t0 = fp2_add(fp2_mul_by_xi(t0), t1)  # x00^2 + xi x11^2
+    t2 = fp2_add(fp2_mul_by_xi(t2), t3)
+    t4 = fp2_add(fp2_mul_by_xi(t4), t5)
+
+    def comb(t, x, sign):
+        # 3 t +- 2 x with ONE normalization (sum(|k|) = 5 <= 64)
+        return L.lincomb([(t, 3), (x, 2 * sign)])
+
+    return jnp.stack(
+        [
+            jnp.stack(
+                [comb(t0, x00, -1), comb(t2, x01, -1), comb(t4, x02, -1)],
+                axis=-3,
+            ),
+            jnp.stack(
+                [comb(t8, x10, +1), comb(t6, x11, +1), comb(t7, x12, +1)],
+                axis=-3,
+            ),
+        ],
+        axis=-4,
+    )
+
+
 def fp12_conj(a):
     return jnp.stack([_h(a, 0), fp6_neg(_h(a, 1))], axis=-4)
 

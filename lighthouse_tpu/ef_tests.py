@@ -267,11 +267,166 @@ def _run_bls_case(case_dir, handler, config, fork) -> CaseResult:
     return CaseResult(case_dir, True)
 
 
+def _run_genesis_case(case_dir, handler, config, fork) -> CaseResult:
+    """genesis/{initialization,validity} (cases/genesis_initialization.rs,
+    genesis_validity.rs)."""
+    preset, spec = _spec_for(config, fork)
+    t = types_for(preset)
+    state_cls = state_class_for(t, fork)
+    from .state_transition.genesis import (
+        initialize_beacon_state_from_eth1,
+        is_valid_genesis_state,
+    )
+
+    if handler == "validity":
+        genesis = state_cls.from_ssz_bytes(_load(case_dir, "genesis.ssz_snappy"))
+        want = bool(_load_yaml(case_dir, "is_valid.yaml"))
+        got = is_valid_genesis_state(genesis, preset, spec)
+        if got != want:
+            return CaseResult(case_dir, False, f"validity {got} != {want}")
+        return CaseResult(case_dir, True)
+
+    if handler != "initialization":
+        return CaseResult(case_dir, False, f"unknown genesis handler {handler}")
+    eth1 = _load_yaml(case_dir, "eth1.yaml")
+    meta = _load_yaml(case_dir, "meta.yaml") or {}
+    from .types.containers import Deposit
+
+    deposits = [
+        Deposit.from_ssz_bytes(_load(case_dir, f"deposits_{i}.ssz_snappy"))
+        for i in range(int(meta.get("deposits_count", 0)))
+    ]
+    header = None
+    if meta.get("execution_payload_header"):
+        raw = _load(case_dir, "execution_payload_header.ssz_snappy")
+        header = t.ExecutionPayloadHeader.from_ssz_bytes(raw)
+    block_hash = bytes.fromhex(str(eth1["eth1_block_hash"]).removeprefix("0x"))
+    state = initialize_beacon_state_from_eth1(
+        block_hash,
+        int(eth1["eth1_timestamp"]),
+        deposits,
+        preset,
+        spec,
+        execution_payload_header=header,
+    )
+    want = state_cls.from_ssz_bytes(_load(case_dir, "state.ssz_snappy"))
+    if state.tree_hash_root() != want.tree_hash_root():
+        return CaseResult(case_dir, False, "genesis state root mismatch")
+    return CaseResult(case_dir, True)
+
+
+def _run_shuffling_case(case_dir, handler, config, fork) -> CaseResult:
+    """shuffling/core (cases/shuffling.rs): both compute_shuffled_index
+    and the whole-list fast path must reproduce the mapping, under the
+    config's round count (mainnet 90 / minimal 10)."""
+    from .utils.shuffle import compute_shuffled_index, shuffle_list
+
+    _, spec = _spec_for(config, fork)
+    rounds = spec.shuffle_round_count
+    data = _load_yaml(case_dir, "mapping.yaml")
+    count = int(data["count"])
+    mapping = [int(x) for x in data["mapping"]]
+    if count == 0:
+        return CaseResult(case_dir, mapping == [])
+    seed = bytes.fromhex(str(data["seed"]).removeprefix("0x"))
+    got = [compute_shuffled_index(i, count, seed, rounds) for i in range(count)]
+    if got != mapping:
+        return CaseResult(case_dir, False, "compute_shuffled_index mismatch")
+    # the vector's mapping[i] is shuffled(i); shuffle_list's backwards
+    # direction reproduces exactly that on the identity list
+    got_list = shuffle_list(list(range(count)), seed, forwards=False, rounds=rounds)
+    if got_list != mapping:
+        return CaseResult(case_dir, False, "shuffle_list mismatch")
+    return CaseResult(case_dir, True)
+
+
+def _run_fork_case(case_dir, handler, config, fork) -> CaseResult:
+    """fork/fork (cases/fork.rs): upgrade the previous fork's pre-state."""
+    preset, spec = _spec_for(config, fork)
+    t = types_for(preset)
+    from .state_transition.upgrades import upgrade_to_altair, upgrade_to_bellatrix
+
+    prev = {"altair": "phase0", "bellatrix": "altair"}.get(fork)
+    if prev is None:
+        return CaseResult(case_dir, False, f"fork test for {fork}")
+    pre_cls = state_class_for(t, prev)
+    post_cls = state_class_for(t, fork)
+    pre = pre_cls.from_ssz_bytes(_load(case_dir, "pre.ssz_snappy"))
+    upgraded = (
+        upgrade_to_altair(pre, preset, spec)
+        if fork == "altair"
+        else upgrade_to_bellatrix(pre, preset, spec)
+    )
+    want = post_cls.from_ssz_bytes(_load(case_dir, "post.ssz_snappy"))
+    if upgraded.tree_hash_root() != want.tree_hash_root():
+        return CaseResult(case_dir, False, "fork post-state root mismatch")
+    return CaseResult(case_dir, True)
+
+
+def _ssz_static_class(name: str, t, fork: str):
+    """Type-name -> class under the given preset/fork, or None if the
+    container is not part of this framework's surface."""
+    from .types import block_classes_for
+    from .types import containers as C
+
+    if name == "BeaconState":
+        return state_class_for(t, fork)
+    if name in ("BeaconBlock", "SignedBeaconBlock", "BeaconBlockBody"):
+        block_cls, signed_cls, body_cls = block_classes_for(t, fork)
+        return {
+            "BeaconBlock": block_cls,
+            "SignedBeaconBlock": signed_cls,
+            "BeaconBlockBody": body_cls,
+        }[name]
+    if fork == "bellatrix" and name == "ExecutionPayload":
+        return t.ExecutionPayload
+    if fork == "bellatrix" and name == "ExecutionPayloadHeader":
+        return t.ExecutionPayloadHeader
+    fork_aware = {
+        "Attestation": t.Attestation,
+        "AttesterSlashing": t.AttesterSlashing,
+        "IndexedAttestation": t.IndexedAttestation,
+        "PendingAttestation": getattr(t, "PendingAttestation", None),
+        "HistoricalBatch": getattr(t, "HistoricalBatch", None),
+        "SyncAggregate": getattr(t, "SyncAggregate", None) if fork != "phase0" else None,
+        "SyncCommittee": getattr(t, "SyncCommittee", None) if fork != "phase0" else None,
+    }
+    if name in fork_aware:
+        return fork_aware[name]
+    return getattr(C, name, None)
+
+
+def _run_ssz_static_case(case_dir, handler, config, fork) -> CaseResult:
+    """ssz_static/<Type> (cases/ssz_static.rs): decode -> re-encode must
+    round-trip and the tree-hash root must match roots.yaml."""
+    preset, _ = _spec_for(config, fork)
+    t = types_for(preset)
+    cls = _ssz_static_class(handler, t, fork)
+    if cls is None:
+        return CaseResult(case_dir, True, "type not in surface (skipped)")
+    raw = _load(case_dir, "serialized.ssz_snappy")
+    roots = _load_yaml(case_dir, "roots.yaml")
+    try:
+        value = cls.from_ssz_bytes(raw)
+    except Exception as e:  # noqa: BLE001
+        return CaseResult(case_dir, False, f"decode failed: {e}")
+    if value.as_ssz_bytes() != raw:
+        return CaseResult(case_dir, False, "re-encode mismatch")
+    want_root = bytes.fromhex(str(roots["root"]).removeprefix("0x"))
+    if value.tree_hash_root() != want_root:
+        return CaseResult(case_dir, False, "tree-hash root mismatch")
+    return CaseResult(case_dir, True)
+
+
 _RUNNERS = {
     "operations": _run_operation_case,
     "sanity": _run_sanity_case,
     "epoch_processing": _run_epoch_case,
     "bls": _run_bls_case,
+    "genesis": _run_genesis_case,
+    "shuffling": _run_shuffling_case,
+    "fork": _run_fork_case,
+    "ssz_static": _run_ssz_static_case,
 }
 
 

@@ -17,5 +17,10 @@ from .per_slot import (  # noqa: F401
     process_slot,
     process_slots,
 )
+from .genesis import (  # noqa: F401
+    initialize_beacon_state_from_eth1,
+    is_valid_genesis_state,
+    try_genesis_from_eth1,
+)
 from .replay import BlockReplayer  # noqa: F401
 from .upgrades import upgrade_to_altair  # noqa: F401

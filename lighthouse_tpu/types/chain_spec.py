@@ -52,6 +52,10 @@ class ChainSpec:
     effective_balance_increment: int = 10**9
     min_deposit_amount: int = 10**9
 
+    # committees (a config value in the reference's chain_spec.rs:
+    # mainnet-preset configs use 90 rounds, minimal-preset configs 10)
+    shuffle_round_count: int = 90
+
     # validator lifecycle
     min_attestation_inclusion_delay: int = 1
     min_seed_lookahead: int = 1
@@ -202,6 +206,7 @@ class ChainSpec:
             bellatrix_fork_version=b"\x02\x00\x00\x01",
             bellatrix_fork_epoch=None,
             seconds_per_slot=6,
+            shuffle_round_count=10,
             min_genesis_active_validator_count=64,
             churn_limit_quotient=32,
             shard_committee_period=64,
@@ -224,5 +229,6 @@ class ChainSpec:
             bellatrix_fork_version=b"\x02\x00\x00\x20",
             bellatrix_fork_epoch=bellatrix_fork_epoch,
             seconds_per_slot=6,
+            shuffle_round_count=10,
             min_genesis_active_validator_count=64,
         )

@@ -24,7 +24,7 @@ class CommitteeCache:
         self.preset = preset
         active = get_active_validator_indices(state, epoch)
         seed = get_seed(state, epoch, _DOM_ATT, preset, spec)
-        perm = shuffle_indices(len(active), seed)
+        perm = shuffle_indices(len(active), seed, spec.shuffle_round_count)
         # shuffling[i] = active[perm[i]]: the committee-ordered validator list
         self.shuffling = [active[p] for p in perm]
         self.committees_per_slot = get_committee_count_per_slot(

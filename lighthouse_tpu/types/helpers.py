@@ -86,6 +86,13 @@ def compute_signing_root(obj, domain: bytes) -> bytes:
     ).tree_hash_root()
 
 
+def validators_registry_root(state) -> bytes:
+    """Registry root with the same list limit the state's field uses
+    (genesis_validators_root computation at genesis)."""
+    field_type = dict(state.ssz_fields)["validators"]
+    return field_type.hash_tree_root(state.validators)
+
+
 # --- block root lookups -----------------------------------------------------
 
 
@@ -166,6 +173,7 @@ def compute_committee(
     index: int,
     count: int,
     perm=None,
+    rounds: int | None = None,
 ):
     """Slice `index` of `count` of the shuffled active set. `perm` may carry
     the precomputed full shuffle (committee-cache path)."""
@@ -173,8 +181,13 @@ def compute_committee(
     start = n * index // count
     end = n * (index + 1) // count
     if perm is None:
+        if rounds is None:
+            # no silent 90-round default: the round count is a config
+            # value (spec.shuffle_round_count) and must come from the
+            # caller, as every production path does
+            raise ValueError("compute_committee without perm needs rounds")
         return [
-            indices[compute_shuffled_index(i, n, seed)]
+            indices[compute_shuffled_index(i, n, seed, rounds)]
             for i in range(start, end)
         ]
     return [indices[perm[i]] for i in range(start, end)]
@@ -194,7 +207,9 @@ def compute_proposer_index(
     i = 0
     total = len(indices)
     while True:
-        shuffled = compute_shuffled_index(i % total, total, seed)
+        shuffled = compute_shuffled_index(
+            i % total, total, seed, spec.shuffle_round_count
+        )
         candidate = indices[shuffled]
         rand = hash32(seed + (i // 32).to_bytes(8, "little"))[i % 32]
         eb = state.validators[candidate].effective_balance

@@ -114,8 +114,6 @@ def interop_genesis_state(
 
 
 def _validators_root(state) -> bytes:
-    from ..ssz import List as SszList
-    from .containers import Validator as V
-    # registry root with the same limit the state uses
-    field_type = dict(state.ssz_fields)["validators"]
-    return field_type.hash_tree_root(state.validators)
+    from .helpers import validators_registry_root
+
+    return validators_registry_root(state)

@@ -27,7 +27,7 @@ def get_sync_committee_indices(
     i = 0
     n = len(active)
     while len(out) < preset.sync_committee_size:
-        shuffled = compute_shuffled_index(i % n, n, seed)
+        shuffled = compute_shuffled_index(i % n, n, seed, spec.shuffle_round_count)
         candidate = active[shuffled]
         rand = hash32(seed + (i // 32).to_bytes(8, "little"))[i % 32]
         eb = state.validators[candidate].effective_balance

@@ -107,6 +107,26 @@ class TestVerify:
         )
         assert not verify_signature_sets(batch, seed=3)
 
+    def test_repeated_messages_dedup_path(self, backend):
+        """Batches with repeated messages (the production gossip shape the
+        jax backend dedups hash-to-curve work for): distinct signers over
+        shared messages verify; one signer on the WRONG shared message
+        still poisons the batch (the dedup gather must not conflate
+        per-set signatures)."""
+        msgs = [b"\x71" * 32, b"\x72" * 32]
+        batch = []
+        signers = []
+        for i in range(6):
+            sk, pk = keypair()
+            m = msgs[i % 2]
+            batch.append(SignatureSet.single_pubkey(sk.sign(m), pk, m))
+            signers.append((sk, pk))
+        assert verify_signature_sets(batch, seed=11)
+        # signer 5 signs msg[1] but the set claims msg[0]
+        sk, pk = signers[5]
+        batch[5] = SignatureSet.single_pubkey(sk.sign(msgs[1]), pk, msgs[0])
+        assert not verify_signature_sets(batch, seed=11)
+
     def test_infinity_signature_never_verifies(self, backend):
         _, pk = keypair()
         s = SignatureSet.single_pubkey(Signature.infinity(), pk, b"\x00" * 32)

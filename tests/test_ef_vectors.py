@@ -100,6 +100,126 @@ def mini_tree(tmp_path_factory):
     process_epoch(post, MINIMAL, h.spec)
     _write(case, "post.ssz_snappy", post.as_ssz_bytes())
 
+    # genesis/validity: around both thresholds (real semantic anchors --
+    # expected values are forced by construction, not by running the
+    # function under test)
+    from lighthouse_tpu.types import interop_genesis_state
+
+    spec_min = ChainSpec.minimal()
+    case = base / "genesis" / "validity" / "pyspec_tests" / "valid"
+    g_ok = interop_genesis_state(
+        64, MINIMAL, spec_min, genesis_time=spec_min.min_genesis_time
+    )
+    _write(case, "genesis.ssz_snappy", g_ok.as_ssz_bytes())
+    _write_yaml(case, "is_valid.yaml", True)
+    case = base / "genesis" / "validity" / "pyspec_tests" / "too_few_validators"
+    g_few = interop_genesis_state(
+        32, MINIMAL, spec_min, genesis_time=spec_min.min_genesis_time
+    )
+    _write(case, "genesis.ssz_snappy", g_few.as_ssz_bytes())
+    _write_yaml(case, "is_valid.yaml", False)
+    case = base / "genesis" / "validity" / "pyspec_tests" / "too_early"
+    g_early = interop_genesis_state(
+        64, MINIMAL, spec_min, genesis_time=spec_min.min_genesis_time - 1
+    )
+    _write(case, "genesis.ssz_snappy", g_early.as_ssz_bytes())
+    _write_yaml(case, "is_valid.yaml", False)
+
+    # genesis/initialization: deposits -> candidate state (fake backend
+    # accepts the placeholder proofs-of-possession; the proofs themselves
+    # are REAL merkle branches and verified by process_deposit)
+    from lighthouse_tpu.crypto.bls import INFINITY_SIGNATURE as INF_SIG
+    from lighthouse_tpu.eth1.deposit_tree import DepositDataTree
+    from lighthouse_tpu.state_transition.genesis import (
+        initialize_beacon_state_from_eth1,
+    )
+    from lighthouse_tpu.types import interop_keypair
+    from lighthouse_tpu.types.containers import DepositData
+
+    dep_data = []
+    tree = DepositDataTree()
+    for i in range(8):
+        _, pk = interop_keypair(i)
+        d = DepositData(
+            pubkey=pk.to_bytes(),
+            withdrawal_credentials=b"\x00" * 32,
+            amount=32 * 10**9,
+            signature=INF_SIG,
+        )
+        dep_data.append(d)
+        tree.push(d)
+    deposits = [tree.deposit(i, dep_data[i], i + 1) for i in range(8)]
+    eth1_hash = b"\x42" * 32
+    eth1_time = spec_min.min_genesis_time
+    case = base / "genesis" / "initialization" / "pyspec_tests" / "from_deposits"
+    _write_yaml(
+        case,
+        "eth1.yaml",
+        {
+            "eth1_block_hash": "0x" + eth1_hash.hex(),
+            "eth1_timestamp": eth1_time,
+        },
+    )
+    _write_yaml(case, "meta.yaml", {"deposits_count": 8})
+    for i, d in enumerate(deposits):
+        _write(case, f"deposits_{i}.ssz_snappy", d.as_ssz_bytes())
+    expected = initialize_beacon_state_from_eth1(
+        eth1_hash, eth1_time, deposits, MINIMAL, spec_min
+    )
+    # the vector file itself can only pin determinism (expected state is
+    # generated by the function under test); the SEMANTICS are guarded
+    # here at fixture-build time, independent of the runner
+    assert len(expected.validators) == 8
+    assert expected.genesis_time == eth1_time + spec_min.genesis_delay
+    assert expected.eth1_deposit_index == 8
+    assert all(v.activation_epoch == 0 for v in expected.validators)
+    assert all(
+        v.effective_balance == spec_min.max_effective_balance
+        for v in expected.validators
+    )
+    _write(case, "state.ssz_snappy", expected.as_ssz_bytes())
+
+    # fork/fork under altair: upgrade of a phase0 pre-state
+    spec_alt = ChainSpec.minimal()
+    spec_alt.altair_fork_epoch = 0
+    from lighthouse_tpu.state_transition.upgrades import upgrade_to_altair
+
+    case = (
+        root / "tests" / "minimal" / "altair" / "fork" / "fork"
+        / "pyspec_tests" / "altair_fork_basic"
+    )
+    pre_fork = clone_state(h.state)
+    _write(case, "pre.ssz_snappy", pre_fork.as_ssz_bytes())
+    _write_yaml(case, "meta.yaml", {"fork": "altair"})
+    post_fork = upgrade_to_altair(clone_state(pre_fork), MINIMAL, spec_alt)
+    _write(case, "post.ssz_snappy", post_fork.as_ssz_bytes())
+
+    # shuffling/core: PINNED literal mapping (regression anchor computed at
+    # minimal's 10 rounds; a shuffle change must fail this loudly)
+    case = base / "shuffling" / "core" / "shuffle" / "shuffle_8"
+    _write_yaml(
+        case,
+        "mapping.yaml",
+        {
+            "seed": "0x4fe91d85d6bd0e77bc51b7bfdc7823e1f9b7d6f1e2a14f0277624b51ab7cbb88",
+            "count": 8,
+            "mapping": [5, 1, 3, 2, 0, 7, 4, 6],
+        },
+    )
+
+    # ssz_static: round-trip + root for a fixed-size and a nested container
+    from lighthouse_tpu.types.containers import Checkpoint
+
+    cp = Checkpoint(epoch=7, root=b"\x0c" * 32)
+    case = base / "ssz_static" / "Checkpoint" / "ssz_random" / "case_0"
+    _write(case, "serialized.ssz_snappy", cp.as_ssz_bytes())
+    _write_yaml(case, "roots.yaml", {"root": "0x" + cp.tree_hash_root().hex()})
+    case = base / "ssz_static" / "BeaconState" / "ssz_random" / "case_0"
+    _write(case, "serialized.ssz_snappy", h.state.as_ssz_bytes())
+    _write_yaml(
+        case, "roots.yaml", {"root": "0x" + h.state.tree_hash_root().hex()}
+    )
+
     # bls handlers under general/: oracle-signed, backend-verified
     g = root / "tests" / "general" / "phase0" / "bls"
     sk1, sk2 = SecretKey(101), SecretKey(202)
@@ -239,7 +359,9 @@ def test_mini_tree_state_cases(mini_tree):
     results = run_tree(mini_tree, configs=("minimal",))
     failures = [r for r in results if not r.ok]
     assert not failures, failures
-    assert len(results) == 5  # slots, 2x blocks, exit, epoch
+    # slots, 2x blocks, exit, epoch, 3x genesis validity, genesis init,
+    # altair fork, shuffling, 2x ssz_static
+    assert len(results) == 13
 
 
 def test_mini_tree_bls_cases_on_jax_backend(mini_tree):

@@ -1,0 +1,154 @@
+"""Genesis from eth1 deposits (state_transition/genesis.py; reference
+consensus/state_processing/src/genesis.rs + beacon_node/genesis).
+
+Runs under the fake backend for bulk flows (proofs are still REAL merkle
+branches) with one real-crypto case pinning the proof-of-possession gate.
+"""
+
+import pytest
+
+from lighthouse_tpu.crypto.bls import INFINITY_SIGNATURE, set_backend
+from lighthouse_tpu.eth1.deposit_tree import DepositDataTree
+from lighthouse_tpu.eth1.service import Eth1Service, MockEth1Provider
+from lighthouse_tpu.state_transition.genesis import (
+    initialize_beacon_state_from_eth1,
+    is_valid_genesis_state,
+    try_genesis_from_eth1,
+)
+from lighthouse_tpu.types import MINIMAL, ChainSpec, interop_keypair
+from lighthouse_tpu.types.chain_spec import DOMAIN_DEPOSIT
+from lighthouse_tpu.types.containers import DepositData, DepositMessage
+from lighthouse_tpu.types.helpers import compute_domain, compute_signing_root
+
+SPEC = ChainSpec.minimal()
+
+
+@pytest.fixture(autouse=True)
+def _fake_backend():
+    set_backend("fake")
+    yield
+    set_backend("jax_tpu")
+
+
+def _deposit_data(i: int, amount: int = 32 * 10**9, sign: bool = False):
+    sk, pk = interop_keypair(i)
+    d = DepositData(
+        pubkey=pk.to_bytes(),
+        withdrawal_credentials=b"\x00" * 32,
+        amount=amount,
+        signature=INFINITY_SIGNATURE,
+    )
+    if sign:
+        msg = DepositMessage(
+            pubkey=d.pubkey,
+            withdrawal_credentials=d.withdrawal_credentials,
+            amount=d.amount,
+        )
+        domain = compute_domain(DOMAIN_DEPOSIT, SPEC.genesis_fork_version, bytes(32))
+        d.signature = sk.sign(compute_signing_root(msg, domain)).to_bytes()
+    return d
+
+
+def _deposits(datas):
+    tree = DepositDataTree()
+    for d in datas:
+        tree.push(d)
+    return [tree.deposit(i, datas[i], i + 1) for i in range(len(datas))]
+
+
+def test_initialize_activates_full_stakes_and_snaps_balances():
+    datas = [_deposit_data(i) for i in range(4)]
+    datas[3].amount = 17 * 10**9 + 12345  # partial stake: not activated
+    deposits = _deposits(datas)
+    state = initialize_beacon_state_from_eth1(
+        b"\x01" * 32, 1_000_000, deposits, MINIMAL, SPEC
+    )
+    assert len(state.validators) == 4
+    assert state.genesis_time == 1_000_000 + SPEC.genesis_delay
+    assert state.eth1_deposit_index == 4
+    for v in state.validators[:3]:
+        assert v.effective_balance == SPEC.max_effective_balance
+        assert v.activation_epoch == 0
+    partial = state.validators[3]
+    assert partial.effective_balance == 17 * 10**9  # snapped down
+    assert partial.activation_epoch != 0
+    # genesis block header commits to an empty body
+    assert state.latest_block_header.body_root != bytes(32)
+
+
+def test_initialize_merges_top_up_for_duplicate_pubkey():
+    datas = [_deposit_data(0), _deposit_data(1), _deposit_data(0, amount=10**9)]
+    state = initialize_beacon_state_from_eth1(
+        b"\x02" * 32, 5, _deposits(datas), MINIMAL, SPEC
+    )
+    assert len(state.validators) == 2
+    assert state.balances[0] == 33 * 10**9
+
+
+def test_initialize_rejects_bad_proof():
+    datas = [_deposit_data(i) for i in range(2)]
+    deposits = _deposits(datas)
+    # corrupt one branch node of the second deposit's proof
+    proof = list(deposits[1].proof)
+    proof[0] = b"\xff" * 32
+    deposits[1].proof = tuple(proof)
+    with pytest.raises(Exception):
+        initialize_beacon_state_from_eth1(
+            b"\x03" * 32, 5, deposits, MINIMAL, SPEC
+        )
+
+
+def test_bad_proof_of_possession_excluded_under_real_crypto():
+    """With real verification, an unsigned (infinity-signature) deposit is
+    ignored while a properly signed one creates its validator -- the spec's
+    proof-of-possession gate, which the fake backend waves through."""
+    set_backend("cpu")
+    try:
+        datas = [_deposit_data(0, sign=True), _deposit_data(1, sign=False)]
+        state = initialize_beacon_state_from_eth1(
+            b"\x04" * 32, 5, _deposits(datas), MINIMAL, SPEC
+        )
+        assert len(state.validators) == 1
+        _, pk0 = interop_keypair(0)
+        assert bytes(state.validators[0].pubkey) == pk0.to_bytes()
+    finally:
+        set_backend("fake")
+
+
+def test_is_valid_genesis_state_thresholds():
+    datas = [_deposit_data(i) for i in range(SPEC.min_genesis_active_validator_count)]
+    deposits = _deposits(datas)
+    t_ok = SPEC.min_genesis_time  # genesis_time = t + delay >= min: ok
+    state = initialize_beacon_state_from_eth1(
+        b"\x05" * 32, t_ok, deposits, MINIMAL, SPEC
+    )
+    assert is_valid_genesis_state(state, MINIMAL, SPEC)
+    # one validator short
+    state_few = initialize_beacon_state_from_eth1(
+        b"\x05" * 32, t_ok, deposits[:-1], MINIMAL, SPEC
+    )
+    assert not is_valid_genesis_state(state_few, MINIMAL, SPEC)
+    # too early: genesis_time below the minimum
+    early = SPEC.min_genesis_time - SPEC.genesis_delay - 1
+    state_early = initialize_beacon_state_from_eth1(
+        b"\x05" * 32, early, deposits, MINIMAL, SPEC
+    )
+    assert not is_valid_genesis_state(state_early, MINIMAL, SPEC)
+
+
+def test_try_genesis_from_eth1_service_waits_for_enough_deposits():
+    provider = MockEth1Provider()
+    n = SPEC.min_genesis_active_validator_count
+    t0 = SPEC.min_genesis_time
+    # first block carries half the deposits: no genesis yet
+    provider.add_block(t0, [_deposit_data(i) for i in range(n // 2)])
+    svc = Eth1Service(provider)
+    svc.update()
+    assert try_genesis_from_eth1(svc, MINIMAL, SPEC) is None
+    # second block completes the set: genesis forms from that block
+    provider.add_block(t0 + 6, [_deposit_data(i) for i in range(n // 2, n)])
+    svc.update()
+    state = try_genesis_from_eth1(svc, MINIMAL, SPEC)
+    assert state is not None
+    assert len(state.validators) == n
+    assert is_valid_genesis_state(state, MINIMAL, SPEC)

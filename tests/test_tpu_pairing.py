@@ -57,6 +57,45 @@ def test_pairing_matches_oracle_cubed_and_infinity():
         assert T.fp12_to_ref(got[i]) == want
 
 
+def _fp12_from_ref(z) -> jnp.ndarray:
+    """Oracle Fp12 -> (2, 3, 2, W) limb tensor."""
+    rows = []
+    for six in (z.c0, z.c1):
+        rows.append(
+            np.stack(
+                [
+                    T.fp2_from_ints(f2.c0.n, f2.c1.n)
+                    for f2 in (six.c0, six.c1, six.c2)
+                ]
+            )
+        )
+    return jnp.asarray(np.stack(rows), jnp.int32)
+
+
+def test_cyclotomic_square_matches_generic_on_cyclotomic_elements():
+    """Granger-Scott squaring == generic squaring inside the cyclotomic
+    subgroup (the only domain _pow_x_abs uses it in). Elements are built
+    host-side by the easy-part map f -> f^((p^6-1)(p^2+1))."""
+    from lighthouse_tpu.crypto.bls.fields_ref import Fp2 as RFp2, Fp6 as RFp6
+    from lighthouse_tpu.crypto.bls.constants import P
+
+    def rfp12():
+        def r2():
+            return RFp2(rng.randrange(P), rng.randrange(P))
+
+        return Fp12(RFp6(r2(), r2(), r2()), RFp6(r2(), r2(), r2()))
+
+    cyc = []
+    for _ in range(4):
+        f = rfp12()
+        g = f.conj() * f.inv()
+        cyc.append(g.frobenius(2) * g)
+    packed = jnp.stack([_fp12_from_ref(z) for z in cyc])
+    got = jax.jit(T.fp12_cyclotomic_sq)(packed)
+    for i, z in enumerate(cyc):
+        assert T.fp12_to_ref(got[i]) == z.sq()
+
+
 def test_bilinearity_and_product():
     g1, g2 = C.g1_generator(), C.g2_generator()
     a, b = rng.randrange(1, R), rng.randrange(1, R)

@@ -387,6 +387,10 @@ class Bootnode:
                             "peer_id": msg["peer_id"],
                             "host": msg["host"],
                             "port": msg["port"],
+                            # identity pubkey travels with the listing so
+                            # dialers can pin the transcript signature
+                            # BEFORE first contact (the ENR seat)
+                            "identity_pk": msg.get("identity_pk"),
                         }
                     reply = {"ok": True}
                 else:  # list
@@ -518,13 +522,16 @@ class WireBus:
 
     # -- lifecycle -----------------------------------------------------------
 
-    def _wrap_client(self, sock):
+    def _wrap_client(self, sock, expect_pubkey=None):
         if not self.secure:
             return PlainChannel(sock)
         from .secure import handshake_initiator
 
         return handshake_initiator(
-            sock, self.identity_sk, authenticate=self.authenticate
+            sock,
+            self.identity_sk,
+            expect_pubkey=expect_pubkey,
+            authenticate=self.authenticate,
         )
 
     def _wrap_server(self, sock):
@@ -579,9 +586,17 @@ class WireBus:
         for conn in conns:
             conn.close()
 
-    def connect_to(self, host: str, port: int) -> str | None:
+    def connect_to(
+        self, host: str, port: int, expect_pubkey: bytes | None = None
+    ) -> str | None:
         """Dial a peer: HELLO exchange records each other's listen
-        address + topic interests (the identify/handshake seat)."""
+        address + topic interests (the identify/handshake seat).
+
+        With authenticate=True the transcript signature is verified
+        against `expect_pubkey` when the caller knows it (bootnode
+        listing / discovery ENR); otherwise the key the peer presents is
+        PINNED trust-on-first-use, so every later re-dial to this peer id
+        (the persistent _conn_for connections) rejects an impostor."""
         hello = {
             "peer_id": self.peer_id,
             "host": self.host,
@@ -590,7 +605,7 @@ class WireBus:
         }
         try:
             with socket.create_connection((host, port), timeout=10) as s:
-                chan = self._wrap_client(s)
+                chan = self._wrap_client(s, expect_pubkey)
                 chan.send_frame(FRAME_HELLO, json.dumps(hello).encode())
                 ftype, body = chan.recv_frame()
         except OSError as e:
@@ -598,6 +613,13 @@ class WireBus:
         if ftype != FRAME_HELLO:
             return None
         peer = json.loads(body)
+        # only a key the handshake PROVED may pin -- never one claimed in
+        # the reply body, and never the caller's unverified expectation
+        # (with authenticate off, expect_pubkey was not checked by anyone)
+        peer.pop("identity_pk", None)
+        proved = getattr(chan, "peer_pubkey", None)
+        if proved is not None:
+            peer["identity_pk"] = bytes(proved).hex()
         self._record_peer(peer)
         return peer["peer_id"]
 
@@ -608,25 +630,30 @@ class WireBus:
             if isinstance(bootnode, Bootnode)
             else bootnode
         )
-        Bootnode.rpc(
-            host,
-            port,
-            {
-                "op": "register",
-                "peer_id": self.peer_id,
-                "host": self.host,
-                "port": self.port,
-            },
-        )
+        register = {
+            "op": "register",
+            "peer_id": self.peer_id,
+            "host": self.host,
+            "port": self.port,
+        }
+        if self.authenticate and self.identity_sk is not None:
+            register["identity_pk"] = (
+                self.identity_sk.public_key().to_bytes().hex()
+            )
+        Bootnode.rpc(host, port, register)
         listed = Bootnode.rpc(host, port, {"op": "list"})["peers"]
         connected = 0
         for p in listed:
             if p["peer_id"] == self.peer_id:
                 continue
             try:
-                if self.connect_to(p["host"], p["port"]):
+                # inside the try: a poisoned registration (malformed hex,
+                # wrong type) must skip THIS peer, not abort the bootstrap
+                pk_hex = p.get("identity_pk")
+                expect = bytes.fromhex(pk_hex) if pk_hex else None
+                if self.connect_to(p["host"], p["port"], expect_pubkey=expect):
                     connected += 1
-            except ConnectionError:
+            except (ConnectionError, ValueError, TypeError):
                 continue
         return connected
 
@@ -634,9 +661,21 @@ class WireBus:
 
     def _record_peer(self, peer: dict) -> None:
         with self._lock:
+            prev = self._peers.get(peer["peer_id"], {})
+            prev_pin = prev.get("identity_pk")
+            new_pin = peer.get("identity_pk")
+            if prev_pin and new_pin and new_pin != prev_pin:
+                # a DIFFERENT proved key claiming an already-pinned peer id
+                # is a hijack attempt: adopting it (key OR address) would
+                # redirect the persistent dials to the newcomer. Drop the
+                # record; the legitimate peer keeps its pin and address.
+                return
             self._peers[peer["peer_id"]] = {
                 "host": peer["host"],
                 "port": peer["port"],
+                # an existing pin survives re-records (HELLO refreshes
+                # carry no identity; they must not unpin a peer)
+                "identity_pk": new_pin or prev_pin,
                 "topics": set(peer.get("topics", ())),
             }
             # mesh maintenance: a new subscriber can graft into any topic
@@ -678,8 +717,12 @@ class WireBus:
                 return None
             conn = self._conns.get(peer_id)
             if conn is None:
+                pk_hex = info.get("identity_pk")
+                expect = bytes.fromhex(pk_hex) if pk_hex else None
                 conn = self._conns[peer_id] = _PeerConn(
-                    info["host"], info["port"], wrap=self._wrap_client
+                    info["host"],
+                    info["port"],
+                    wrap=lambda s, e=expect: self._wrap_client(s, e),
                 )
             return conn
 
@@ -762,6 +805,13 @@ class WireBus:
     def _handle_frame(self, chan, ftype: int, body: bytes, bucket=None) -> None:
         if ftype == FRAME_HELLO:
             peer = json.loads(body)
+            # inbound side: pin the identity the dialer PROVED during the
+            # handshake (chan.peer_pubkey), never one it merely claims
+            proved = getattr(chan, "peer_pubkey", None)
+            if proved is not None:
+                peer["identity_pk"] = bytes(proved).hex()
+            else:
+                peer.pop("identity_pk", None)
             self._record_peer(peer)
             reply = {
                 "peer_id": self.peer_id,

@@ -239,6 +239,115 @@ class TestWireBusSecure:
             b1.stop()
             b2.stop()
 
+    def test_authenticated_bus_pins_peer_identity(self):
+        """The MITM hole review found: authenticate=True must bind the
+        connection to a SPECIFIC peer key, not whatever key the other end
+        presents. Dialing with the wrong expectation fails; dialing with
+        the right one succeeds and PINS, so persistent re-dials verify
+        against the pinned key; an impostor (right address, different
+        identity key) is rejected on re-dial."""
+        from lighthouse_tpu.crypto.bls import SecretKey
+        from lighthouse_tpu.network.wire import WireBus
+        from lighthouse_tpu.types import MINIMAL
+
+        sk1, sk2, sk_evil = SecretKey(301), SecretKey(302), SecretKey(666)
+        b1 = WireBus(MINIMAL, secure=True, identity_sk=sk1, authenticate=True)
+        b2 = WireBus(MINIMAL, secure=True, identity_sk=sk2, authenticate=True)
+        evil = WireBus(
+            MINIMAL, secure=True, identity_sk=sk_evil, authenticate=True
+        )
+        try:
+            b1.listen("p1")
+            b2.listen("p2")
+            # wrong expectation: handshake must fail
+            with pytest.raises(ConnectionError):
+                b1.connect_to(
+                    b2.host, b2.port,
+                    expect_pubkey=sk_evil.public_key().to_bytes(),
+                )
+            # right expectation: connects and pins
+            assert b1.connect_to(
+                b2.host, b2.port,
+                expect_pubkey=sk2.public_key().to_bytes(),
+            ) == "p2"
+            assert (
+                b1._peers["p2"]["identity_pk"]
+                == sk2.public_key().to_bytes().hex()
+            )
+            # impostor takes over p2's ADDRESS with a different key:
+            # the pinned persistent dial must refuse it
+            b2.stop()
+            evil.listen("p2", port=0)
+            with b1._lock:
+                b1._peers["p2"]["host"] = evil.host
+                b1._peers["p2"]["port"] = evil.port
+            with pytest.raises(ConnectionError):
+                b1.request("p1", "p2", "/eth2/beacon_chain/req/status/1", {})
+        finally:
+            b1.stop()
+            evil.stop()
+
+    def test_inbound_hello_cannot_replace_pin(self):
+        """Peer-id hijack (review finding): an attacker with its OWN valid
+        identity key dials in claiming an already-pinned peer_id. The
+        conflicting proved key must not replace the pin or the address."""
+        from lighthouse_tpu.crypto.bls import SecretKey
+        from lighthouse_tpu.network.wire import WireBus
+        from lighthouse_tpu.types import MINIMAL
+
+        sk1, sk2, sk_evil = SecretKey(321), SecretKey(322), SecretKey(667)
+        b1 = WireBus(MINIMAL, secure=True, identity_sk=sk1, authenticate=True)
+        b2 = WireBus(MINIMAL, secure=True, identity_sk=sk2, authenticate=True)
+        evil = WireBus(
+            MINIMAL, secure=True, identity_sk=sk_evil, authenticate=True
+        )
+        try:
+            b1.listen("p1")
+            b2.listen("p2")
+            assert b1.connect_to(b2.host, b2.port) == "p2"
+            pinned = b1._peers["p2"]["identity_pk"]
+            addr = (b1._peers["p2"]["host"], b1._peers["p2"]["port"])
+            # the attacker dials b1 and claims to BE p2
+            evil.listen("p2", port=0)
+            evil.connect_to(b1.host, b1.port)
+            assert b1._peers["p2"]["identity_pk"] == pinned
+            assert (
+                b1._peers["p2"]["host"],
+                b1._peers["p2"]["port"],
+            ) == addr
+        finally:
+            b1.stop()
+            b2.stop()
+            evil.stop()
+
+    def test_tofu_pin_without_prior_expectation(self):
+        """connect_to without expect_pubkey still pins the key the peer
+        PROVED in the handshake (trust-on-first-use), and the inbound side
+        pins the dialer's proven key -- never a claimed one."""
+        from lighthouse_tpu.crypto.bls import SecretKey
+        from lighthouse_tpu.network.wire import WireBus
+        from lighthouse_tpu.types import MINIMAL
+
+        sk1, sk2 = SecretKey(311), SecretKey(312)
+        b1 = WireBus(MINIMAL, secure=True, identity_sk=sk1, authenticate=True)
+        b2 = WireBus(MINIMAL, secure=True, identity_sk=sk2, authenticate=True)
+        try:
+            b1.listen("p1")
+            b2.listen("p2")
+            assert b1.connect_to(b2.host, b2.port) == "p2"
+            assert (
+                b1._peers["p2"]["identity_pk"]
+                == sk2.public_key().to_bytes().hex()
+            )
+            # responder side pinned the initiator's proven key too
+            assert (
+                b2._peers["p1"]["identity_pk"]
+                == sk1.public_key().to_bytes().hex()
+            )
+        finally:
+            b1.stop()
+            b2.stop()
+
     def test_secure_to_plain_fails_cleanly(self):
         from lighthouse_tpu.network.wire import WireBus
         from lighthouse_tpu.types import MINIMAL

@@ -385,6 +385,10 @@ class BeaconChain:
                     att.data.slot,
                     block.slot,
                 )
+        # ... and strips equivocators' fork-choice weight (spec
+        # on_attester_slashing; fork_choice.rs on_attester_slashing)
+        for slashing in block.body.attester_slashings:
+            self.fork_choice.on_attester_slashing(slashing)
         old_head = self.head_root
         self.recompute_head()
         if self.head_root != old_head:

@@ -10,13 +10,20 @@ at an extracted tree to run them. The same machinery executes the
 in-repo synthesized mini-tree (tests/test_ef_vectors.py), so the walker,
 ssz_snappy loading, and case semantics stay exercised offline.
 
-Implemented runners (cases/{operations,epoch_processing,sanity,bls}.rs):
+Implemented runners (cases/{operations,epoch_processing,sanity,bls,
+genesis_initialization,genesis_validity,shuffling,fork,ssz_static,
+fork_choice}.rs):
 
   operations/{attestation,attester_slashing,proposer_slashing,
               voluntary_exit,deposit,sync_aggregate}
   epoch_processing/* (full epoch transition per handler)
   sanity/{slots,blocks}
   bls/{verify,aggregate_verify,fast_aggregate_verify,batch_verify}
+  genesis/{initialization,validity}
+  shuffling/core
+  fork/fork (phase0->altair, altair->bellatrix upgrades)
+  ssz_static/<Type> (round-trip + tree-hash root)
+  fork_choice/* (scripted tick/block/attestation/slashing steps + checks)
 """
 
 from __future__ import annotations
@@ -418,6 +425,246 @@ def _run_ssz_static_case(case_dir, handler, config, fork) -> CaseResult:
     return CaseResult(case_dir, True)
 
 
+def _run_fork_choice_case(case_dir, handler, config, fork) -> CaseResult:
+    """fork_choice/* scripted steps (cases/fork_choice.rs): anchor state +
+    block, then tick / block / attestation / attester_slashing steps with
+    interleaved head & checkpoint checks. Ticks are ABSOLUTE seconds
+    (slot = (tick - genesis_time) // seconds_per_slot, set_tick at
+    fork_choice.rs:366)."""
+    from .fork_choice import ForkChoice
+    from .state_transition import clone_state
+    from .state_transition.context import ConsensusContext
+    from .types import block_classes_for, compute_epoch_at_slot
+
+    preset, spec = _spec_for(config, fork)
+    t = types_for(preset)
+    state_cls = state_class_for(t, fork)
+    block_cls, signed_cls, _ = block_classes_for(t, fork)
+    anchor_state = state_cls.from_ssz_bytes(
+        _load(case_dir, "anchor_state.ssz_snappy")
+    )
+    anchor_block = block_cls.from_ssz_bytes(
+        _load(case_dir, "anchor_block.ssz_snappy")
+    )
+    anchor_root = anchor_block.tree_hash_root()
+    states = {anchor_root: anchor_state}
+    anchor_epoch = compute_epoch_at_slot(anchor_state.slot, preset)
+    anchor_cp = (anchor_epoch, anchor_root)
+    fc = ForkChoice(
+        preset,
+        spec,
+        genesis_slot=anchor_block.slot,
+        genesis_root=anchor_root,
+        justified_checkpoint=anchor_cp,
+        finalized_checkpoint=anchor_cp,
+        state_lookup=lambda root: states.get(root),
+    )
+    genesis_time = anchor_state.genesis_time
+    time_now = genesis_time + anchor_state.slot * spec.seconds_per_slot
+
+    def att_indices(att):
+        """Indexed attestation via the attested block's state advanced to
+        the attestation slot (committees are epoch+seed functions of it)."""
+        base = states.get(bytes(att.data.beacon_block_root))
+        if base is None:
+            raise ValueError("attestation for unknown block")
+        st = base
+        if st.slot < att.data.slot:
+            st = process_slots(clone_state(st), att.data.slot, preset, spec)
+        ctxt = ConsensusContext(preset, spec)
+        return list(ctxt.get_indexed_attestation(st, att).attesting_indices)
+
+    steps = _load_yaml(case_dir, "steps.yaml") or []
+    for step in steps:
+        if "tick" in step:
+            time_now = int(step["tick"])
+            fc.on_tick((time_now - genesis_time) // spec.seconds_per_slot)
+        elif "block" in step:
+            raw = _load(case_dir, f"{step['block']}.ssz_snappy")
+            signed = signed_cls.from_ssz_bytes(raw)
+            block = signed.message
+            expected_valid = bool(step.get("valid", True))
+            try:
+                parent = states.get(bytes(block.parent_root))
+                if parent is None:
+                    raise ValueError("unknown parent")
+                st = process_slots(
+                    clone_state(parent), block.slot, preset, spec
+                )
+                ctxt = ConsensusContext(preset, spec)
+                per_block_processing(
+                    st,
+                    signed,
+                    preset,
+                    spec,
+                    strategy=BlockSignatureStrategy.VERIFY_BULK,
+                    ctxt=ctxt,
+                )
+                root = block.tree_hash_root()
+                fc.on_block(signed, root, st)
+                states[root] = st
+                # spec on_block: the block's attestations and slashings
+                # feed the store too (is_from_block semantics)
+                for att in block.body.attestations:
+                    fc.on_attestation(
+                        att.data.slot,
+                        att_indices(att),
+                        bytes(att.data.beacon_block_root),
+                    )
+                for sl in block.body.attester_slashings:
+                    fc.on_attester_slashing(sl)
+                applied = True
+            except (BlockProcessingError, ValueError, KeyError):
+                applied = False
+            if applied != expected_valid:
+                return CaseResult(
+                    case_dir,
+                    False,
+                    f"block {step['block']}: applied={applied} "
+                    f"expected valid={expected_valid}",
+                )
+        elif "attestation" in step:
+            raw = _load(case_dir, f"{step['attestation']}.ssz_snappy")
+            att = t.Attestation.from_ssz_bytes(raw)
+            expected_valid = bool(step.get("valid", True))
+            try:
+                fc.on_attestation(
+                    att.data.slot,
+                    att_indices(att),
+                    bytes(att.data.beacon_block_root),
+                )
+                applied = True
+            except (ValueError, KeyError):
+                applied = False
+            if applied != expected_valid:
+                return CaseResult(
+                    case_dir,
+                    False,
+                    f"attestation {step['attestation']}: applied={applied} "
+                    f"expected valid={expected_valid}",
+                )
+        elif "attester_slashing" in step:
+            raw = _load(case_dir, f"{step['attester_slashing']}.ssz_snappy")
+            sl = t.AttesterSlashing.from_ssz_bytes(raw)
+            expected_valid = bool(step.get("valid", True))
+            try:
+                fc.on_attester_slashing(sl)
+                applied = True
+            except (ValueError, KeyError):
+                applied = False
+            if applied != expected_valid:
+                return CaseResult(
+                    case_dir,
+                    False,
+                    f"attester_slashing: applied={applied} "
+                    f"expected valid={expected_valid}",
+                )
+        elif "checks" in step:
+            checks = step["checks"]
+            if "head" in checks:
+                head = fc.get_head()
+                want = bytes.fromhex(
+                    str(checks["head"]["root"]).removeprefix("0x")
+                )
+                if head != want:
+                    return CaseResult(
+                        case_dir,
+                        False,
+                        f"head {head.hex()} != {want.hex()}",
+                    )
+                idx = fc.proto.proto_array.indices[head]
+                if fc.proto.proto_array.nodes[idx].slot != int(
+                    checks["head"]["slot"]
+                ):
+                    return CaseResult(case_dir, False, "head slot mismatch")
+            for key, attr in (
+                ("justified_checkpoint", fc.justified_checkpoint),
+                ("finalized_checkpoint", fc.finalized_checkpoint),
+                ("u_justified_checkpoint", fc.unrealized_justified_checkpoint),
+                ("u_finalized_checkpoint", fc.unrealized_finalized_checkpoint),
+            ):
+                if key in checks and checks[key] is not None:
+                    want_cp = (
+                        int(checks[key]["epoch"]),
+                        bytes.fromhex(
+                            str(checks[key]["root"]).removeprefix("0x")
+                        ),
+                    )
+                    if attr != want_cp:
+                        return CaseResult(
+                            case_dir, False, f"{key} {attr} != {want_cp}"
+                        )
+            if "proposer_boost_root" in checks:
+                got = fc.proto.proposer_boost_root or bytes(32)
+                want = bytes.fromhex(
+                    str(checks["proposer_boost_root"]).removeprefix("0x")
+                )
+                if got != want:
+                    return CaseResult(
+                        case_dir, False, "proposer_boost_root mismatch"
+                    )
+            if "time" in checks and checks["time"] is not None:
+                if time_now != int(checks["time"]):
+                    return CaseResult(case_dir, False, "time mismatch")
+            if "genesis_time" in checks and checks["genesis_time"] is not None:
+                if genesis_time != int(checks["genesis_time"]):
+                    return CaseResult(case_dir, False, "genesis_time mismatch")
+    return CaseResult(case_dir, True)
+
+
+def _run_transition_case(case_dir, handler, config, fork) -> CaseResult:
+    """transition/core (cases/transition.rs): apply blocks across a fork
+    boundary; pre-fork blocks decode under the previous fork, the rest
+    under the target fork, upgrades happen inside process_slots."""
+    from .state_transition import clone_state
+    from .types import block_classes_for
+
+    preset, spec = _spec_for(config, fork)
+    t = types_for(preset)
+    meta = _load_yaml(case_dir, "meta.yaml")
+    fork_epoch = int(meta["fork_epoch"])
+    prev = {"altair": "phase0", "bellatrix": "altair"}.get(fork)
+    if prev is None:
+        return CaseResult(case_dir, False, f"transition to {fork}")
+    # the pre-fork phase runs under the PREVIOUS fork's rules until
+    # fork_epoch; rebuild the spec with the real schedule
+    if fork == "altair":
+        spec.altair_fork_epoch = fork_epoch
+        spec.bellatrix_fork_epoch = None
+    else:
+        spec.altair_fork_epoch = 0
+        spec.bellatrix_fork_epoch = fork_epoch
+    pre_cls = state_class_for(t, prev)
+    _, signed_prev, _ = block_classes_for(t, prev)
+    _, signed_post, _ = block_classes_for(t, fork)
+    state = pre_cls.from_ssz_bytes(_load(case_dir, "pre.ssz_snappy"))
+    fork_block = meta.get("fork_block")
+    fork_block = -1 if fork_block is None else int(fork_block)
+    try:
+        for i in range(int(meta["blocks_count"])):
+            raw = _load(case_dir, f"blocks_{i}.ssz_snappy")
+            cls = signed_prev if i <= fork_block else signed_post
+            signed = cls.from_ssz_bytes(raw)
+            state = process_slots(state, signed.message.slot, preset, spec)
+            per_block_processing(
+                state,
+                signed,
+                preset,
+                spec,
+                strategy=BlockSignatureStrategy.VERIFY_BULK,
+            )
+        applied = True
+    except (BlockProcessingError, ValueError) as e:
+        applied, error = False, str(e)
+    post_raw = _load(case_dir, "post.ssz_snappy")
+    if not applied:
+        return CaseResult(case_dir, False, f"valid transition rejected: {error}")
+    want = state_class_for(t, fork).from_ssz_bytes(post_raw)
+    if state.tree_hash_root() != want.tree_hash_root():
+        return CaseResult(case_dir, False, "transition post-state mismatch")
+    return CaseResult(case_dir, True)
+
+
 _RUNNERS = {
     "operations": _run_operation_case,
     "sanity": _run_sanity_case,
@@ -427,6 +674,8 @@ _RUNNERS = {
     "shuffling": _run_shuffling_case,
     "fork": _run_fork_case,
     "ssz_static": _run_ssz_static_case,
+    "fork_choice": _run_fork_choice_case,
+    "transition": _run_transition_case,
 }
 
 

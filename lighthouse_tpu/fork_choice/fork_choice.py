@@ -222,6 +222,18 @@ class ForkChoice:
                     (attestation_slot, v, bytes(block_root), epoch)
                 )
 
+    def on_attester_slashing(self, attester_slashing) -> None:
+        """Spec on_attester_slashing (fork_choice.rs on_attester_slashing):
+        validators attesting in BOTH of the slashing's attestations
+        equivocated; their fork-choice weight is removed permanently.
+        Takes the (already-validated) AttesterSlashing operation so every
+        call site shares one intersection computation."""
+        common = set(
+            attester_slashing.attestation_1.attesting_indices
+        ) & set(attester_slashing.attestation_2.attesting_indices)
+        for v in common:
+            self.proto.process_attester_slashing(int(v))
+
     # -- head (fork_choice.rs:527 get_head) ---------------------------------
 
     def get_head(self) -> bytes:

@@ -391,6 +391,10 @@ class ProtoArrayForkChoice:
         )
         self.votes: dict[int, VoteTracker] = {}
         self.balances: list[int] = []
+        # validators proven to equivocate (attester slashings): their
+        # latest message is removed and future votes are ignored
+        # (proto_array_fork_choice.rs process_attester_slashing)
+        self.equivocating_indices: set[int] = set()
         self.proposer_boost_root: bytes | None = None
         self._previous_boost: tuple[bytes, int] | None = None
         self.proto_array.on_block(
@@ -438,9 +442,17 @@ class ProtoArrayForkChoice:
     def is_optimistic(self, root: bytes) -> bool:
         return self.execution_status_of(root) == "optimistic"
 
+    def process_attester_slashing(self, validator_index: int) -> None:
+        """Equivocation proven: drop the validator's fork-choice weight
+        permanently. The vote removal itself happens lazily in
+        _compute_deltas on the next find_head."""
+        self.equivocating_indices.add(validator_index)
+
     def process_attestation(
         self, validator_index: int, block_root: bytes, target_epoch: int
     ):
+        if validator_index in self.equivocating_indices:
+            return
         vote = self.votes.setdefault(validator_index, VoteTracker())
         # a fresh tracker accepts any vote (incl. target epoch 0 in the
         # chain's first epoch -- the reference's `vote == default` escape)
@@ -498,6 +510,16 @@ class ProtoArrayForkChoice:
                 if validator < len(new_balances)
                 else 0
             )
+            if validator in self.equivocating_indices:
+                # remove the latest message once; the dead tracker then
+                # never re-enters (process_attestation ignores the index)
+                if vote.current_root:
+                    idx = self.proto_array.indices.get(vote.current_root)
+                    if idx is not None:
+                        deltas[idx] -= old_balance
+                vote.current_root = b""
+                vote.next_root = b""
+                continue
             if vote.current_root == vote.next_root and old_balance == new_balance:
                 continue
             idx = self.proto_array.indices.get(vote.current_root)

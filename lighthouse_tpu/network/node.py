@@ -249,7 +249,12 @@ class NetworkNode:
             self._mark_op_seen(op.tree_hash_root())  # don't re-import our own
             self.bus.publish(self.peer_id, topic, op)
 
-        self.slasher_service = SlasherService(slasher, self.op_pool, broadcast)
+        self.slasher_service = SlasherService(
+            slasher,
+            self.op_pool,
+            broadcast,
+            fork_choice=self.chain.fork_choice,
+        )
 
     def attach_discovery(self, disc) -> None:
         """Wire a DiscoveryService: subnet-service rotations advertise
@@ -320,11 +325,17 @@ class NetworkNode:
         )
 
     def _on_gossip_attester_slashing(self, slashing, source: str) -> None:
+        def accept(s):
+            self.op_pool.insert_attester_slashing(s)
+            # a proven equivocation also strips the equivocators'
+            # fork-choice weight immediately (spec on_attester_slashing)
+            self.chain.fork_choice.on_attester_slashing(s)
+
         self._handle_op_gossip(
             slashing,
             source,
             self._validate_attester_slashing,
-            self.op_pool.insert_attester_slashing,
+            accept,
         )
 
     def _on_gossip_voluntary_exit(self, signed_exit, source: str) -> None:

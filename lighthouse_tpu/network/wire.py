@@ -366,6 +366,46 @@ def _recv_frame(sock: socket.socket):
 # -- discovery registry (the discv5 seat) -------------------------------------
 
 
+def _register_signing_root(peer_id: str, host: str, port: int) -> bytes:
+    return hashlib.sha256(
+        b"lighthouse-tpu-bootnode-register\x00"
+        + peer_id.encode()
+        + b"\x00"
+        + host.encode()
+        + b"\x00"
+        + int(port).to_bytes(4, "big")
+    ).digest()
+
+
+def _sign_register_proof(identity_sk, peer_id: str, host: str, port: int) -> str:
+    return identity_sk.sign(
+        _register_signing_root(peer_id, host, port)
+    ).to_bytes().hex()
+
+
+def _verify_register_proof(
+    pk_bytes: bytes, sig_bytes: bytes, peer_id: str, host: str, port: int
+) -> bool:
+    """Pinned to the CPU oracle like ENR verification (discovery.py):
+    identity registrations are control plane, never routed through the
+    ambient batch backend (which may be `fake` under test)."""
+    from ..crypto import bls
+    from ..crypto.bls.backends import cpu as cpu_bls
+
+    try:
+        pk = bls.PublicKey.from_bytes(pk_bytes)
+        sig = bls.Signature.from_bytes(sig_bytes)
+        return cpu_bls.verify_signature_sets(
+            [
+                bls.SignatureSet.single_pubkey(
+                    sig, pk, _register_signing_root(peer_id, host, port)
+                )
+            ]
+        )
+    except Exception:  # noqa: BLE001 -- malformed material == invalid
+        return False
+
+
 class Bootnode:
     """Peer directory over TCP: REGISTER/LIST json frames (reference
     boot_node/ + discovery/enr.rs directory role)."""
@@ -382,17 +422,7 @@ class Bootnode:
                     return
                 msg = json.loads(body)
                 if msg.get("op") == "register":
-                    with outer._lock:
-                        outer._peers[msg["peer_id"]] = {
-                            "peer_id": msg["peer_id"],
-                            "host": msg["host"],
-                            "port": msg["port"],
-                            # identity pubkey travels with the listing so
-                            # dialers can pin the transcript signature
-                            # BEFORE first contact (the ENR seat)
-                            "identity_pk": msg.get("identity_pk"),
-                        }
-                    reply = {"ok": True}
+                    reply = outer._register(msg)
                 else:  # list
                     with outer._lock:
                         reply = {"peers": list(outer._peers.values())}
@@ -408,6 +438,48 @@ class Bootnode:
         self._thread = threading.Thread(
             target=self._server.serve_forever, daemon=True
         )
+
+    def _register(self, msg: dict) -> dict:
+        """Identity-carrying registrations must PROVE key possession (a
+        BLS signature over the registration transcript) and may not rebind
+        a peer_id already registered under a different key -- otherwise the
+        listing dialers pin from (the ENR seat) lets an attacker bind a
+        victim's peer_id to its own key (review finding)."""
+        pk_hex = msg.get("identity_pk")
+        entry = {
+            "peer_id": msg["peer_id"],
+            "host": msg["host"],
+            "port": msg["port"],
+            "identity_pk": None,
+        }
+        if pk_hex is not None:
+            try:
+                pk_bytes = bytes.fromhex(str(pk_hex))
+                sig_bytes = bytes.fromhex(str(msg["register_proof"]))
+            except (KeyError, ValueError, TypeError):
+                return {"ok": False, "error": "malformed identity proof"}
+            if not _verify_register_proof(
+                pk_bytes, sig_bytes, msg["peer_id"], msg["host"], msg["port"]
+            ):
+                return {"ok": False, "error": "bad identity proof"}
+            entry["identity_pk"] = pk_hex
+        with self._lock:
+            prev = self._peers.get(msg["peer_id"])
+            if prev is not None and prev.get("identity_pk") not in (
+                None,
+                pk_hex,
+            ):
+                # first-come binding: a different key cannot take the id
+                return {"ok": False, "error": "peer id bound to another key"}
+            if (
+                prev is not None
+                and prev.get("identity_pk") is not None
+                and pk_hex is None
+            ):
+                # an unauthenticated re-register may not strip a binding
+                return {"ok": False, "error": "peer id requires identity"}
+            self._peers[msg["peer_id"]] = entry
+        return {"ok": True}
 
     def start(self) -> "Bootnode":
         self._thread.start()
@@ -639,6 +711,9 @@ class WireBus:
         if self.authenticate and self.identity_sk is not None:
             register["identity_pk"] = (
                 self.identity_sk.public_key().to_bytes().hex()
+            )
+            register["register_proof"] = _sign_register_proof(
+                self.identity_sk, self.peer_id, self.host, self.port
             )
         Bootnode.rpc(host, port, register)
         listed = Bootnode.rpc(host, port, {"op": "list"})["peers"]

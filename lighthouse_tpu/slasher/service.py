@@ -15,11 +15,17 @@ from .slasher import Slasher
 
 
 class SlasherService:
-    def __init__(self, slasher: Slasher, op_pool, broadcast=None):
+    def __init__(
+        self, slasher: Slasher, op_pool, broadcast=None, fork_choice=None
+    ):
         self.slasher = slasher
         self.op_pool = op_pool
         # fn(kind: "attester_slashing" | "proposer_slashing", op) -> None
         self.broadcast = broadcast
+        # the detecting node strips equivocators' fork-choice weight
+        # immediately, same as nodes learning via gossip (spec
+        # on_attester_slashing)
+        self.fork_choice = fork_choice
         # lifetime counters (the reference's slasher metrics seat)
         self.attestations_seen = 0
         self.blocks_seen = 0
@@ -53,6 +59,8 @@ class SlasherService:
         for s in new_att:
             self.attester_slashings_found += 1
             self.op_pool.insert_attester_slashing(s)
+            if self.fork_choice is not None:
+                self.fork_choice.on_attester_slashing(s)
             if self.broadcast is not None:
                 self.broadcast("attester_slashing", s)
         for s in new_prop:

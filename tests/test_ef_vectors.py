@@ -220,6 +220,111 @@ def mini_tree(tmp_path_factory):
         case, "roots.yaml", {"root": "0x" + h.state.tree_hash_root().hex()}
     )
 
+    # fork_choice: a scripted 2-block chain + an invalid block + an
+    # attestation step, with head/checkpoint/boost checks along the way
+    from lighthouse_tpu.types import types_for
+    from lighthouse_tpu.types.containers import BeaconBlockHeader
+
+    tt = types_for(MINIMAL)
+    fc_h = StateHarness(32, MINIMAL, ChainSpec.minimal(), sign=False)
+    # spec-shaped genesis header: body_root commits to an empty body so a
+    # real anchor BeaconBlock can share the header's root
+    default_body_root = tt.BeaconBlockBody.default().tree_hash_root()
+    fc_h.state.latest_block_header = BeaconBlockHeader(
+        body_root=default_body_root
+    )
+    anchor_state = clone_state(fc_h.state)
+    anchor_block = tt.BeaconBlock(
+        slot=0,
+        proposer_index=0,
+        parent_root=bytes(32),
+        state_root=anchor_state.tree_hash_root(),
+        body=tt.BeaconBlockBody.default(),
+    )
+    anchor_root = anchor_block.tree_hash_root()
+    case = (
+        base / "fork_choice" / "on_block" / "pyspec_tests" / "chain_and_checks"
+    )
+    _write(case, "anchor_state.ssz_snappy", anchor_state.as_ssz_bytes())
+    _write(case, "anchor_block.ssz_snappy", anchor_block.as_ssz_bytes())
+    signed1, post1 = fc_h.produce_block(1)
+    assert bytes(signed1.message.parent_root) == anchor_root
+    root1 = signed1.message.tree_hash_root()
+    fc_h.state = post1  # produce_block does not advance the harness
+    signed2, post2 = fc_h.produce_block(2)
+    assert bytes(signed2.message.parent_root) == root1
+    fc_h.state = post2
+    root2 = signed2.message.tree_hash_root()
+    _write(case, "block_0.ssz_snappy", signed1.as_ssz_bytes())
+    _write(case, "block_1.ssz_snappy", signed2.as_ssz_bytes())
+    bad, _ = fc_h.produce_block(3)
+    bad.message.proposer_index = (bad.message.proposer_index + 1) % 32
+    _write(case, "block_bad.ssz_snappy", bad.as_ssz_bytes())
+    spd = ChainSpec.minimal().seconds_per_slot
+    gt = anchor_state.genesis_time
+    att_view = process_slots(clone_state(post2), 3, MINIMAL, fc_h.spec)
+    att = fc_h.attestations_for_slot(att_view, 2)[0]
+    _write(case, "att_0.ssz_snappy", att.as_ssz_bytes())
+    _write_yaml(
+        case,
+        "steps.yaml",
+        [
+            {"tick": gt + 2 * spd},
+            {"block": "block_0"},
+            {"block": "block_1"},
+            {
+                "checks": {
+                    "head": {"slot": 2, "root": "0x" + root2.hex()},
+                    "justified_checkpoint": {
+                        "epoch": 0,
+                        "root": "0x" + anchor_root.hex(),
+                    },
+                    "time": gt + 2 * spd,
+                    "genesis_time": gt,
+                }
+            },
+            {"block": "block_bad", "valid": False},
+            {"tick": gt + 3 * spd},
+            {"attestation": "att_0"},
+            {
+                "checks": {
+                    "head": {"slot": 2, "root": "0x" + root2.hex()},
+                    # boost expired at the slot 3 tick
+                    "proposer_boost_root": "0x" + bytes(32).hex(),
+                }
+            },
+        ],
+    )
+
+    # transition: blocks across the phase0 -> altair boundary
+    spec_tr = ChainSpec.minimal()
+    spec_tr.altair_fork_epoch = 1
+    h_tr = StateHarness(32, MINIMAL, spec_tr, sign=False)
+    pre_tr = clone_state(h_tr.state)
+    tr_blocks = []
+    for slot in (SLOTS - 1, SLOTS, SLOTS + 1):
+        signed, post_tr = h_tr.produce_block(slot)
+        h_tr.state = post_tr  # chain the blocks
+        tr_blocks.append(signed)
+    case = (
+        root / "tests" / "minimal" / "altair" / "transition" / "core"
+        / "pyspec_tests" / "basic"
+    )
+    _write(case, "pre.ssz_snappy", pre_tr.as_ssz_bytes())
+    for i, b in enumerate(tr_blocks):
+        _write(case, f"blocks_{i}.ssz_snappy", b.as_ssz_bytes())
+    _write_yaml(
+        case,
+        "meta.yaml",
+        {
+            "post_fork": "altair",
+            "fork_epoch": 1,
+            "fork_block": 0,
+            "blocks_count": 3,
+        },
+    )
+    _write(case, "post.ssz_snappy", post_tr.as_ssz_bytes())
+
     # bls handlers under general/: oracle-signed, backend-verified
     g = root / "tests" / "general" / "phase0" / "bls"
     sk1, sk2 = SecretKey(101), SecretKey(202)
@@ -360,8 +465,8 @@ def test_mini_tree_state_cases(mini_tree):
     failures = [r for r in results if not r.ok]
     assert not failures, failures
     # slots, 2x blocks, exit, epoch, 3x genesis validity, genesis init,
-    # altair fork, shuffling, 2x ssz_static
-    assert len(results) == 13
+    # altair fork, shuffling, 2x ssz_static, fork_choice, transition
+    assert len(results) == 15
 
 
 def test_mini_tree_bls_cases_on_jax_backend(mini_tree):

@@ -48,6 +48,25 @@ class TestVoteScenarios:
         fc.process_attestation(1, root(1), 2)
         assert head(fc, [1, 1]) == root(1)
 
+    def test_attester_slashing_removes_weight_permanently(self):
+        """Spec on_attester_slashing (proto_array_fork_choice.rs
+        process_attester_slashing): an equivocator's latest message stops
+        counting and its future votes are ignored."""
+        fc = make_fc()
+        fc.process_block(1, root(1), GENESIS, (1, GENESIS), (1, GENESIS))
+        fc.process_block(1, root(2), GENESIS, (1, GENESIS), (1, GENESIS))
+        # two votes hold the head on the lower root
+        fc.process_attestation(0, root(1), 2)
+        fc.process_attestation(1, root(1), 2)
+        fc.process_attestation(2, root(2), 2)
+        assert head(fc, [1, 1, 1]) == root(1)
+        # validator 0 equivocates: weight drops, head flips on tie-break
+        fc.process_attester_slashing(0)
+        assert head(fc, [1, 1, 1]) == root(2)
+        # its future votes are dead
+        fc.process_attestation(0, root(1), 3)
+        assert head(fc, [1, 1, 1]) == root(2)
+
     def test_vote_change_moves_weight(self):
         fc = make_fc()
         fc.process_block(1, root(1), GENESIS, (1, GENESIS), (1, GENESIS))

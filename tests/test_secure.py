@@ -287,6 +287,82 @@ class TestWireBusSecure:
             b1.stop()
             evil.stop()
 
+    def test_bootnode_registration_requires_key_proof(self):
+        """Review finding: bootnode registrations carrying an identity key
+        must PROVE possession and cannot rebind an already-bound peer_id
+        to a different key -- otherwise an attacker seeds the listing with
+        its own key under a victim's id and every dialer pins it."""
+        from lighthouse_tpu.crypto.bls import SecretKey
+        from lighthouse_tpu.network.wire import (
+            Bootnode,
+            _sign_register_proof,
+        )
+
+        sk_victim, sk_evil = SecretKey(331), SecretKey(668)
+        bn = Bootnode().start()
+        try:
+            # unproved identity claim: refused
+            r = Bootnode.rpc(
+                bn.host,
+                bn.port,
+                {
+                    "op": "register",
+                    "peer_id": "victim",
+                    "host": "127.0.0.1",
+                    "port": 1,
+                    "identity_pk": sk_evil.public_key().to_bytes().hex(),
+                },
+            )
+            assert not r["ok"]
+            # proved registration binds
+            r = Bootnode.rpc(
+                bn.host,
+                bn.port,
+                {
+                    "op": "register",
+                    "peer_id": "victim",
+                    "host": "127.0.0.1",
+                    "port": 2,
+                    "identity_pk": sk_victim.public_key().to_bytes().hex(),
+                    "register_proof": _sign_register_proof(
+                        sk_victim, "victim", "127.0.0.1", 2
+                    ),
+                },
+            )
+            assert r["ok"]
+            # a DIFFERENT (even proved) key cannot take the id
+            r = Bootnode.rpc(
+                bn.host,
+                bn.port,
+                {
+                    "op": "register",
+                    "peer_id": "victim",
+                    "host": "127.0.0.1",
+                    "port": 3,
+                    "identity_pk": sk_evil.public_key().to_bytes().hex(),
+                    "register_proof": _sign_register_proof(
+                        sk_evil, "victim", "127.0.0.1", 3
+                    ),
+                },
+            )
+            assert not r["ok"]
+            # an unauthenticated re-register cannot strip the binding
+            r = Bootnode.rpc(
+                bn.host,
+                bn.port,
+                {
+                    "op": "register",
+                    "peer_id": "victim",
+                    "host": "127.0.0.1",
+                    "port": 4,
+                },
+            )
+            assert not r["ok"]
+            listed = Bootnode.rpc(bn.host, bn.port, {"op": "list"})["peers"]
+            assert listed[0]["port"] == 2  # the proved binding survived
+        finally:
+            bn.stop()
+
     def test_inbound_hello_cannot_replace_pin(self):
         """Peer-id hijack (review finding): an attacker with its OWN valid
         identity key dials in claiming an already-pinned peer_id. The

@@ -378,6 +378,7 @@ class BeaconChain:
                 att.data.slot,
                 list(indexed.attesting_indices),
                 bytes(att.data.beacon_block_root),
+                from_block=True,
             )
             if self.validator_monitor is not None:
                 self.validator_monitor.on_attestation_included(

@@ -18,6 +18,10 @@ class ForkChoiceError(ValueError):
     pass
 
 
+# spec INTERVALS_PER_SLOT: the first third of the slot is "timely"
+_INTERVALS_PER_SLOT = 3
+
+
 def _justified_balances(state, preset, epoch: int | None = None) -> list[int]:
     """Spec fork-choice weights: EFFECTIVE balances of validators active at
     the given epoch (default: the state's epoch); everyone else weighs zero
@@ -59,6 +63,9 @@ class ForkChoice:
         # contested forks.
         self.state_lookup = state_lookup
         self.current_slot = genesis_slot
+        # intra-slot seconds (spec INTERVALS_PER_SLOT timeliness); slot
+        # ticks reset it to 0, on_tick_time sets the real offset
+        self.seconds_into_slot = 0
         self.queued_attestations: list[tuple[int, int, bytes, int]] = []
         self.proto = ProtoArrayForkChoice(
             genesis_slot,
@@ -76,11 +83,25 @@ class ForkChoice:
             self._dequeue_attestations()
             # proposer boost expires at the start of the next slot
             self.proto.proposer_boost_root = None
+            # a plain slot tick lands at the slot start: timely until told
+            # otherwise by on_tick_time
+            self.seconds_into_slot = 0
             # epoch-boundary pull-up (fork_choice.rs on_tick): what was
             # unrealized last epoch is realized now, even if no block has
             # imported since -- the late-epoch justification race
             if self.current_slot % self.preset.slots_per_epoch == 0:
                 self._realize_unrealized()
+
+    def on_tick_time(self, time_s: int, genesis_time: int) -> None:
+        """Second-granular tick (spec on_tick): advances the slot AND
+        records the intra-slot offset, which gates proposer-boost
+        timeliness (a block arriving past SECONDS_PER_SLOT /
+        INTERVALS_PER_SLOT into its slot gets no boost)."""
+        slot = (time_s - genesis_time) // self.spec.seconds_per_slot
+        self.on_tick(slot)
+        self.seconds_into_slot = (time_s - genesis_time) % (
+            self.spec.seconds_per_slot
+        )
 
     def _realize_unrealized(self) -> None:
         if (
@@ -135,6 +156,22 @@ class ForkChoice:
         block = signed_block.message
         if block.slot > self.current_slot:
             raise ForkChoiceError("block from the future")
+        # spec on_block: the block must descend from the finalized
+        # checkpoint (fork_choice.rs is_finalized_checkpoint_or_descendant)
+        fin_epoch, fin_root = self.finalized_checkpoint
+        parent_root = bytes(block.parent_root)
+        if (
+            fin_root in self.proto.proto_array.indices
+            and parent_root in self.proto.proto_array.indices
+        ):
+            parent_idx = self.proto.proto_array.indices[parent_root]
+            parent_node = self.proto.proto_array.nodes[parent_idx]
+            if not self.proto.proto_array._descends_from(
+                parent_node, fin_root
+            ):
+                raise ForkChoiceError(
+                    "block does not descend from the finalized checkpoint"
+                )
         jc = (
             state.current_justified_checkpoint.epoch,
             bytes(state.current_justified_checkpoint.root),
@@ -175,9 +212,14 @@ class ForkChoice:
             unrealized_justified_checkpoint=ujc,
         )
         # proposer boost: only the FIRST timely block of the slot gets it
-        # (spec: set only when proposer_boost_root is empty)
+        # (spec: set only when proposer_boost_root is empty AND the block
+        # arrived within SECONDS_PER_SLOT / INTERVALS_PER_SLOT)
+        timely = self.seconds_into_slot * _INTERVALS_PER_SLOT < (
+            self.spec.seconds_per_slot
+        )
         if (
             block.slot == self.current_slot
+            and timely
             and self.proto.proposer_boost_root is None
         ):
             self.proto.proposer_boost_root = block_root
@@ -211,9 +253,23 @@ class ForkChoice:
     # -- attestations (fork_choice.rs:1162 on_attestation) ------------------
 
     def on_attestation(
-        self, attestation_slot: int, attesting_indices, block_root: bytes
+        self,
+        attestation_slot: int,
+        attesting_indices,
+        block_root: bytes,
+        from_block: bool = False,
     ) -> None:
         epoch = compute_epoch_at_slot(attestation_slot, self.preset)
+        if not from_block:
+            # spec validate_on_attestation (gossip path only; attestations
+            # carried in blocks are exempt from the recency asserts)
+            if attestation_slot > self.current_slot:
+                raise ForkChoiceError("attestation from a future slot")
+            current_epoch = compute_epoch_at_slot(
+                self.current_slot, self.preset
+            )
+            if epoch < max(current_epoch, 1) - 1:
+                raise ForkChoiceError("attestation epoch too old")
         for v in attesting_indices:
             if attestation_slot + 1 <= self.current_slot:
                 self.proto.process_attestation(v, bytes(block_root), epoch)

@@ -366,7 +366,11 @@ def _recv_frame(sock: socket.socket):
 # -- discovery registry (the discv5 seat) -------------------------------------
 
 
-def _register_signing_root(peer_id: str, host: str, port: int) -> bytes:
+def _register_signing_root(
+    peer_id: str, host: str, port: int, seq: int
+) -> bytes:
+    # seq gives the proof freshness (the ENR seq-number seat): a replayed
+    # old registration cannot revert a peer's entry to a stale address
     return hashlib.sha256(
         b"lighthouse-tpu-bootnode-register\x00"
         + peer_id.encode()
@@ -374,17 +378,25 @@ def _register_signing_root(peer_id: str, host: str, port: int) -> bytes:
         + host.encode()
         + b"\x00"
         + int(port).to_bytes(4, "big")
+        + int(seq).to_bytes(8, "big")
     ).digest()
 
 
-def _sign_register_proof(identity_sk, peer_id: str, host: str, port: int) -> str:
+def _sign_register_proof(
+    identity_sk, peer_id: str, host: str, port: int, seq: int
+) -> str:
     return identity_sk.sign(
-        _register_signing_root(peer_id, host, port)
+        _register_signing_root(peer_id, host, port, seq)
     ).to_bytes().hex()
 
 
 def _verify_register_proof(
-    pk_bytes: bytes, sig_bytes: bytes, peer_id: str, host: str, port: int
+    pk_bytes: bytes,
+    sig_bytes: bytes,
+    peer_id: str,
+    host: str,
+    port: int,
+    seq: int,
 ) -> bool:
     """Pinned to the CPU oracle like ENR verification (discovery.py):
     identity registrations are control plane, never routed through the
@@ -398,7 +410,7 @@ def _verify_register_proof(
         return cpu_bls.verify_signature_sets(
             [
                 bls.SignatureSet.single_pubkey(
-                    sig, pk, _register_signing_root(peer_id, host, port)
+                    sig, pk, _register_signing_root(peer_id, host, port, seq)
                 )
             ]
         )
@@ -451,18 +463,26 @@ class Bootnode:
             "host": msg["host"],
             "port": msg["port"],
             "identity_pk": None,
+            "seq": 0,
         }
         if pk_hex is not None:
             try:
                 pk_bytes = bytes.fromhex(str(pk_hex))
                 sig_bytes = bytes.fromhex(str(msg["register_proof"]))
+                seq = int(msg["seq"])
             except (KeyError, ValueError, TypeError):
                 return {"ok": False, "error": "malformed identity proof"}
             if not _verify_register_proof(
-                pk_bytes, sig_bytes, msg["peer_id"], msg["host"], msg["port"]
+                pk_bytes,
+                sig_bytes,
+                msg["peer_id"],
+                msg["host"],
+                msg["port"],
+                seq,
             ):
                 return {"ok": False, "error": "bad identity proof"}
             entry["identity_pk"] = pk_hex
+            entry["seq"] = seq
         with self._lock:
             prev = self._peers.get(msg["peer_id"])
             if prev is not None and prev.get("identity_pk") not in (
@@ -471,13 +491,13 @@ class Bootnode:
             ):
                 # first-come binding: a different key cannot take the id
                 return {"ok": False, "error": "peer id bound to another key"}
-            if (
-                prev is not None
-                and prev.get("identity_pk") is not None
-                and pk_hex is None
-            ):
-                # an unauthenticated re-register may not strip a binding
-                return {"ok": False, "error": "peer id requires identity"}
+            if prev is not None and prev.get("identity_pk") is not None:
+                if pk_hex is None:
+                    # an unauthenticated re-register may not strip a binding
+                    return {"ok": False, "error": "peer id requires identity"}
+                if entry["seq"] <= prev.get("seq", 0):
+                    # replayed/stale proof may not revert the entry
+                    return {"ok": False, "error": "stale registration seq"}
             self._peers[msg["peer_id"]] = entry
         return {"ok": True}
 
@@ -709,11 +729,13 @@ class WireBus:
             "port": self.port,
         }
         if self.authenticate and self.identity_sk is not None:
+            seq = time.time_ns()
             register["identity_pk"] = (
                 self.identity_sk.public_key().to_bytes().hex()
             )
+            register["seq"] = seq
             register["register_proof"] = _sign_register_proof(
-                self.identity_sk, self.peer_id, self.host, self.port
+                self.identity_sk, self.peer_id, self.host, self.port, seq
             )
         Bootnode.rpc(host, port, register)
         listed = Bootnode.rpc(host, port, {"op": "list"})["peers"]

@@ -441,12 +441,12 @@ def _process_epoch_base(state, preset, spec):
     state.current_epoch_attestations = ()
 
 
-def _attestation_deltas(state, preset, spec, cache_map, total_balance):
-    """Phase0 get_attestation_deltas (reference
-    per_epoch_processing/base/rewards_and_penalties.rs)."""
+def attestation_component_deltas(state, preset, spec, cache_map, total_balance):
+    """Phase0 reward/penalty deltas SPLIT BY COMPONENT, matching the EF
+    rewards vectors' file set (cases/rewards.rs; reference
+    per_epoch_processing/base/rewards_and_penalties.rs): source, target,
+    head, inclusion_delay, inactivity -- each (rewards, penalties)."""
     n = len(state.validators)
-    rewards = [0] * n
-    penalties = [0] * n
     previous_epoch = _previous_epoch(state, preset)
     sqrt_total = integer_squareroot(total_balance)
     eligible = _eligible_validator_indices(state, preset)
@@ -457,7 +457,14 @@ def _attestation_deltas(state, preset, spec, cache_map, total_balance):
     target_atts = _matching_target_attestations(state, previous_epoch, preset)
     head_atts = _matching_head_attestations(state, previous_epoch, preset)
 
-    for atts in (source_atts, target_atts, head_atts):
+    out: dict[str, tuple[list[int], list[int]]] = {}
+    for name, atts in (
+        ("source", source_atts),
+        ("target", target_atts),
+        ("head", head_atts),
+    ):
+        rewards = [0] * n
+        penalties = [0] * n
         attesting = _attesting_indices(state, atts, preset, spec, cache_map)
         attesting_balance = get_total_balance(state, attesting, spec)
         for i in eligible:
@@ -473,8 +480,10 @@ def _attestation_deltas(state, preset, spec, cache_map, total_balance):
                     )
             else:
                 penalties[i] += base
+        out[name] = (rewards, penalties)
 
-    # inclusion delay rewards (source attesters only)
+    # inclusion delay rewards (source attesters only; no penalties)
+    rewards = [0] * n
     source_attesting = _attesting_indices(
         state, source_atts, preset, spec, cache_map
     )
@@ -493,8 +502,10 @@ def _attestation_deltas(state, preset, spec, cache_map, total_balance):
         rewards[a.proposer_index] += proposer_reward
         max_attester_reward = base - proposer_reward
         rewards[i] += max_attester_reward // a.inclusion_delay
+    out["inclusion_delay"] = (rewards, [0] * n)
 
-    # inactivity penalties
+    # inactivity penalties (no rewards)
+    penalties = [0] * n
     if in_leak:
         target_attesting = _attesting_indices(
             state, target_atts, preset, spec, cache_map
@@ -510,6 +521,22 @@ def _attestation_deltas(state, preset, spec, cache_map, total_balance):
                     * delay
                     // spec.inactivity_penalty_quotient
                 )
+    out["inactivity"] = ([0] * n, penalties)
+    return out
+
+
+def _attestation_deltas(state, preset, spec, cache_map, total_balance):
+    """Phase0 get_attestation_deltas: the component sum."""
+    n = len(state.validators)
+    rewards = [0] * n
+    penalties = [0] * n
+    components = attestation_component_deltas(
+        state, preset, spec, cache_map, total_balance
+    )
+    for r, p in components.values():
+        for i in range(n):
+            rewards[i] += r[i]
+            penalties[i] += p[i]
     return rewards, penalties
 
 
@@ -595,10 +622,10 @@ def _process_inactivity_updates(state, preset, spec):
     state.inactivity_scores = tuple(scores)
 
 
-def _flag_deltas(state, preset, spec, total_balance):
+def flag_component_deltas(state, preset, spec, total_balance):
+    """Altair reward/penalty deltas split by component (source, target,
+    head, inactivity), matching the EF rewards vectors' altair file set."""
     n = len(state.validators)
-    rewards = [0] * n
-    penalties = [0] * n
     previous_epoch = _previous_epoch(state, preset)
     eligible = _eligible_validator_indices(state, preset)
     in_leak = _is_in_inactivity_leak(state, preset, spec)
@@ -608,7 +635,11 @@ def _flag_deltas(state, preset, spec, total_balance):
 
     from .participation import WEIGHT_DENOMINATOR
 
+    out: dict[str, tuple[list[int], list[int]]] = {}
+    names = {0: "source", 1: "target", 2: "head"}
     for flag_index, weight in enumerate(PARTICIPATION_FLAG_WEIGHTS):
+        rewards = [0] * n
+        penalties = [0] * n
         participating = _unslashed_participating_indices(
             state, flag_index, previous_epoch, preset
         )
@@ -629,8 +660,10 @@ def _flag_deltas(state, preset, spec, total_balance):
                     )
             elif flag_index != TIMELY_HEAD_FLAG_INDEX:
                 penalties[i] += base * weight // WEIGHT_DENOMINATOR
+        out[names[flag_index]] = (rewards, penalties)
 
-    # inactivity penalties
+    # inactivity penalties (no rewards)
+    penalties = [0] * n
     target = _unslashed_participating_indices(
         state, TIMELY_TARGET_FLAG_INDEX, previous_epoch, preset
     )
@@ -644,6 +677,21 @@ def _flag_deltas(state, preset, spec, total_balance):
                     * spec.inactivity_penalty_quotient_altair
                 )
             )
+    out["inactivity"] = ([0] * n, penalties)
+    return out
+
+
+def _flag_deltas(state, preset, spec, total_balance):
+    """Altair combined deltas: the component sum."""
+    n = len(state.validators)
+    rewards = [0] * n
+    penalties = [0] * n
+    for r, p in flag_component_deltas(
+        state, preset, spec, total_balance
+    ).values():
+        for i in range(n):
+            rewards[i] += r[i]
+            penalties[i] += p[i]
     return rewards, penalties
 
 

@@ -296,6 +296,97 @@ def mini_tree(tmp_path_factory):
         ],
     )
 
+    # rewards: per-component deltas on an attested phase0 state and an
+    # altair state; expected files pin determinism, semantics asserted
+    # at build time (attesters earn, absentees get penalized)
+    from lighthouse_tpu.ef_tests import _deltas_container
+    from lighthouse_tpu.state_transition.per_epoch import (
+        _total_active_balance,
+        attestation_component_deltas,
+        flag_component_deltas,
+    )
+
+    _Deltas = _deltas_container()
+
+    h_rw = StateHarness(32, MINIMAL, ChainSpec.minimal(), sign=False)
+    h_rw.extend_chain(2 * SLOTS, attest=True)
+    rw_state = clone_state(h_rw.state)
+    total = _total_active_balance(rw_state, MINIMAL, h_rw.spec)
+    comps = attestation_component_deltas(rw_state, MINIMAL, h_rw.spec, {}, total)
+    assert sum(comps["source"][0]) > 0  # attesters earned source rewards
+    case = base / "rewards" / "basic" / "pyspec_tests" / "attested_chain"
+    _write(case, "pre.ssz_snappy", rw_state.as_ssz_bytes())
+    for fname, comp in (
+        ("source_deltas", "source"),
+        ("target_deltas", "target"),
+        ("head_deltas", "head"),
+        ("inclusion_delay_deltas", "inclusion_delay"),
+        ("inactivity_penalty_deltas", "inactivity"),
+    ):
+        r, p = comps[comp]
+        _write(
+            case,
+            f"{fname}.ssz_snappy",
+            _Deltas(rewards=r, penalties=p).as_ssz_bytes(),
+        )
+
+    spec_rw_alt = ChainSpec.minimal()
+    spec_rw_alt.altair_fork_epoch = 0
+    h_rwa = StateHarness(32, MINIMAL, spec_rw_alt, sign=False)
+    h_rwa.extend_chain(SLOTS + 2, attest=True)
+    rwa_state = clone_state(h_rwa.state)
+    total_a = _total_active_balance(rwa_state, MINIMAL, spec_rw_alt)
+    comps_a = flag_component_deltas(rwa_state, MINIMAL, spec_rw_alt, total_a)
+    assert sum(comps_a["target"][0]) > 0
+    case = (
+        root / "tests" / "minimal" / "altair" / "rewards" / "basic"
+        / "pyspec_tests" / "attested_chain"
+    )
+    _write(case, "pre.ssz_snappy", rwa_state.as_ssz_bytes())
+    for fname, comp in (
+        ("source_deltas", "source"),
+        ("target_deltas", "target"),
+        ("head_deltas", "head"),
+        ("inactivity_penalty_deltas", "inactivity"),
+    ):
+        r, p = comps_a[comp]
+        _write(
+            case,
+            f"{fname}.ssz_snappy",
+            _Deltas(rewards=r, penalties=p).as_ssz_bytes(),
+        )
+
+    # light_client single merkle proof: current_sync_committee branch out
+    # of the altair state (the gi-54 proof light clients live on)
+    from lighthouse_tpu.ssz.merkle_proof import MerkleTree, verify_merkle_proof
+
+    lc_fields = rwa_state.ssz_fields
+    lc_idx = [name for name, _ in lc_fields].index("current_sync_committee")
+    lc_roots = [
+        ftype.hash_tree_root(getattr(rwa_state, name))
+        for name, ftype in lc_fields
+    ]
+    lc_tree = MerkleTree(lc_roots)
+    lc_gi = lc_tree.generalized_index_of_chunk(lc_idx)
+    lc_branch = lc_tree.proof(lc_idx)
+    assert verify_merkle_proof(
+        lc_roots[lc_idx], lc_branch, lc_gi, rwa_state.tree_hash_root()
+    )
+    case = (
+        root / "tests" / "minimal" / "altair" / "light_client"
+        / "single_merkle_proof" / "BeaconState" / "sync_committee_proof"
+    )
+    _write(case, "object.ssz_snappy", rwa_state.as_ssz_bytes())
+    _write_yaml(
+        case,
+        "proof.yaml",
+        {
+            "leaf": "0x" + lc_roots[lc_idx].hex(),
+            "leaf_index": lc_gi,
+            "branch": ["0x" + b.hex() for b in lc_branch],
+        },
+    )
+
     # transition: blocks across the phase0 -> altair boundary
     spec_tr = ChainSpec.minimal()
     spec_tr.altair_fork_epoch = 1
@@ -465,8 +556,9 @@ def test_mini_tree_state_cases(mini_tree):
     failures = [r for r in results if not r.ok]
     assert not failures, failures
     # slots, 2x blocks, exit, epoch, 3x genesis validity, genesis init,
-    # altair fork, shuffling, 2x ssz_static, fork_choice, transition
-    assert len(results) == 15
+    # altair fork, shuffling, 2x ssz_static, fork_choice, transition,
+    # 2x rewards, light-client merkle proof
+    assert len(results) == 18
 
 
 def test_mini_tree_bls_cases_on_jax_backend(mini_tree):

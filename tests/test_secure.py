@@ -315,20 +315,18 @@ class TestWireBusSecure:
             )
             assert not r["ok"]
             # proved registration binds
-            r = Bootnode.rpc(
-                bn.host,
-                bn.port,
-                {
-                    "op": "register",
-                    "peer_id": "victim",
-                    "host": "127.0.0.1",
-                    "port": 2,
-                    "identity_pk": sk_victim.public_key().to_bytes().hex(),
-                    "register_proof": _sign_register_proof(
-                        sk_victim, "victim", "127.0.0.1", 2
-                    ),
-                },
-            )
+            reg2 = {
+                "op": "register",
+                "peer_id": "victim",
+                "host": "127.0.0.1",
+                "port": 2,
+                "identity_pk": sk_victim.public_key().to_bytes().hex(),
+                "seq": 10,
+                "register_proof": _sign_register_proof(
+                    sk_victim, "victim", "127.0.0.1", 2, 10
+                ),
+            }
+            r = Bootnode.rpc(bn.host, bn.port, reg2)
             assert r["ok"]
             # a DIFFERENT (even proved) key cannot take the id
             r = Bootnode.rpc(
@@ -340,11 +338,32 @@ class TestWireBusSecure:
                     "host": "127.0.0.1",
                     "port": 3,
                     "identity_pk": sk_evil.public_key().to_bytes().hex(),
+                    "seq": 11,
                     "register_proof": _sign_register_proof(
-                        sk_evil, "victim", "127.0.0.1", 3
+                        sk_evil, "victim", "127.0.0.1", 3, 11
                     ),
                 },
             )
+            assert not r["ok"]
+            # a newer self-signed update moves the entry...
+            r = Bootnode.rpc(
+                bn.host,
+                bn.port,
+                {
+                    "op": "register",
+                    "peer_id": "victim",
+                    "host": "127.0.0.1",
+                    "port": 5,
+                    "identity_pk": sk_victim.public_key().to_bytes().hex(),
+                    "seq": 12,
+                    "register_proof": _sign_register_proof(
+                        sk_victim, "victim", "127.0.0.1", 5, 12
+                    ),
+                },
+            )
+            assert r["ok"]
+            # ...but a REPLAYED older frame cannot revert it
+            r = Bootnode.rpc(bn.host, bn.port, reg2)
             assert not r["ok"]
             # an unauthenticated re-register cannot strip the binding
             r = Bootnode.rpc(
@@ -359,7 +378,7 @@ class TestWireBusSecure:
             )
             assert not r["ok"]
             listed = Bootnode.rpc(bn.host, bn.port, {"op": "list"})["peers"]
-            assert listed[0]["port"] == 2  # the proved binding survived
+            assert listed[0]["port"] == 5  # the latest proved binding survived
         finally:
             bn.stop()
 

@@ -41,7 +41,24 @@ def _add_network_args(p):
 # --- beacon node ------------------------------------------------------------
 
 
-def resolve_genesis(args, store, preset, spec):
+def build_eth1_service(args):
+    """Eth1Service over the JSON-RPC provider when --eth1-endpoint is
+    given (reference eth1/src/service.rs polling service)."""
+    if not getattr(args, "eth1_endpoint", None):
+        return None
+    from .eth1 import Eth1Service
+    from .eth1.jsonrpc import JsonRpcEth1Provider
+
+    provider = JsonRpcEth1Provider(args.eth1_endpoint)
+    svc = Eth1Service(provider)
+    try:
+        svc.update()
+    except Exception:  # noqa: BLE001 -- endpoint flap must not kill startup
+        pass  # the per-slot tick retries
+    return svc
+
+
+def resolve_genesis(args, store, preset, spec, eth1_service=None):
     """ClientGenesis resolution (reference client/src/config.rs:15-40 +
     builder.rs:206-340): interop keys, FromStore restart resume, or a
     weak-subjectivity checkpoint (finalized state+block SSZ)."""
@@ -75,6 +92,31 @@ def resolve_genesis(args, store, preset, spec):
             state.genesis_time, spec.seconds_per_slot
         )
         return chain
+    if mode == "deposit-contract":
+        # ClientGenesis::DepositContract: poll the deposit contract until
+        # a valid genesis forms (reference beacon_node/genesis service)
+        from .state_transition.genesis import try_genesis_from_eth1
+
+        if eth1_service is None:
+            raise SystemExit(
+                "--genesis deposit-contract requires --eth1-endpoint"
+            )
+        deadline = time.time() + float(
+            getattr(args, "genesis_timeout", None) or 600.0
+        )
+        while True:
+            state = try_genesis_from_eth1(eth1_service, preset, spec)
+            if state is not None:
+                break
+            if time.time() > deadline:
+                raise SystemExit("no valid genesis formed before timeout")
+            time.sleep(2.0)
+            try:
+                eth1_service.update()
+            except Exception:  # noqa: BLE001 -- keep waiting through flaps
+                continue
+        clock = SystemSlotClock(state.genesis_time, spec.seconds_per_slot)
+        return BeaconChain(store, state, preset, spec, slot_clock=clock)
     genesis = interop_genesis_state(
         args.interop_validators, preset, spec,
         genesis_time=args.genesis_time or int(time.time()),
@@ -116,8 +158,9 @@ def build_beacon_node(args):
     else:
         kv = MemoryStore()
     store = HotColdDB(kv, preset, spec)
-    chain = resolve_genesis(args, store, preset, spec)
-    node = InProcessBeaconNode(chain)
+    eth1_service = build_eth1_service(args)
+    chain = resolve_genesis(args, store, preset, spec, eth1_service)
+    node = InProcessBeaconNode(chain, eth1_service=eth1_service)
     # optional wire networking (lighthouse_network seat): a TCP listener
     # plus bootnode discovery turns this process into a networked peer
     if getattr(args, "listen_port", None) is not None or getattr(
@@ -166,6 +209,12 @@ def cmd_bn(args):
 
     def tick():
         node.chain.on_tick()
+        if node.eth1_service is not None:
+            # deposit-log polling (eth1/src/service.rs update loop)
+            try:
+                node.eth1_service.update()
+            except Exception as e:  # noqa: BLE001 -- eth1 node flaps
+                log.warn("eth1 update failed", error=str(e))
         if hasattr(node, "network"):
             # drain gossip work queued by the wire listener threads
             # (the BeaconProcessor worker seat, beacon_processor.rs)
@@ -386,8 +435,16 @@ def main(argv=None) -> int:
     bn.add_argument("--interop-validators", type=int, default=64)
     bn.add_argument("--genesis-time", type=int, default=None)
     bn.add_argument("--genesis", default="interop",
-                    choices=["interop", "resume", "checkpoint"],
-                    help="genesis resolution (ClientGenesis equivalent)")
+                    choices=["interop", "resume", "checkpoint",
+                             "deposit-contract"],
+                    help="genesis resolution (ClientGenesis equivalent; "
+                         "deposit-contract waits for eth1 deposits)")
+    bn.add_argument("--eth1-endpoint", default=None,
+                    help="eth1 JSON-RPC URL: deposit polling + eth1-data "
+                         "voting + deposit inclusion in produced blocks")
+    bn.add_argument("--genesis-timeout", type=float, default=600.0,
+                    help="deposit-contract genesis: seconds to wait for "
+                         "a valid genesis before giving up")
     bn.add_argument("--checkpoint-state", default=None,
                     help="SSZ file: finalized BeaconState anchor")
     bn.add_argument("--checkpoint-block", default=None,

@@ -23,6 +23,10 @@ from ..types.containers import Eth1Data
 from .deposit_tree import DepositDataTree
 
 
+class Eth1DepositsUnavailable(RuntimeError):
+    """Block production asked for deposits the log cache lacks."""
+
+
 @dataclass
 class Eth1Block:
     number: int
@@ -157,10 +161,19 @@ class Eth1Service:
 
     def deposits_for_block(self, state, max_deposits: int) -> list:
         """Deposits owed by the state (eth1_deposit_index..deposit_count),
-        proved against the state's eth1_data root."""
+        proved against the state's eth1_data root. Raises when the local
+        log cache has not ingested the owed range yet -- the spec obliges
+        the block to carry exactly these deposits, so production must fail
+        loudly rather than build an invalid (or crashing) block."""
         start = state.eth1_deposit_index
+        end = min(state.eth1_data.deposit_count, start + max_deposits)
+        if end > len(self._deposit_data):
+            raise Eth1DepositsUnavailable(
+                f"state owes deposits [{start}, {end}) but only "
+                f"{len(self._deposit_data)} logs are ingested"
+            )
         count = state.eth1_data.deposit_count
         return [
             self.deposit_tree.deposit(i, self._deposit_data[i], count)
-            for i in range(start, min(count, start + max_deposits))
+            for i in range(start, end)
         ]

@@ -34,6 +34,7 @@ class InProcessBeaconNode:
         naive_pool: NaiveAggregationPool | None = None,
         sync_message_pool=None,
         sync_contribution_pool=None,
+        eth1_service=None,
     ):
         from ..chain.sync_committee_verification import (
             ObservedSyncAggregators,
@@ -60,6 +61,10 @@ class InProcessBeaconNode:
         # optional mev-boost builder handle (BuilderHttpClient); None =
         # local payload production only
         self.builder = None
+        # optional Eth1Service: block production then votes eth1_data at
+        # the follow distance and packs the deposits the winning vote owes
+        # (reference eth1/src/service.rs + block production deposits)
+        self.eth1_service = eth1_service
         self.healthy = True  # toggled by tests to exercise VC failover
 
     # -- status --------------------------------------------------------------
@@ -162,6 +167,22 @@ class InProcessBeaconNode:
         t = types_for(self.preset)
         body.randao_reveal = bytes(randao_reveal)
         body.eth1_data = state.eth1_data
+        if self.eth1_service is not None:
+            # eth1 vote + the deposits the state owes under it. The vote
+            # must be applied to a SCRATCH view first: expected deposit
+            # count follows the eth1_data that WINS the voting period,
+            # which (on minimal presets) can be this very vote.
+            from ..state_transition.per_block import process_eth1_data
+
+            vote = self.eth1_service.eth1_data_for_block(state)
+            body.eth1_data = vote
+            view = clone_state(state)
+            process_eth1_data(view, vote, self.preset)
+            body.deposits = tuple(
+                self.eth1_service.deposits_for_block(
+                    view, self.preset.max_deposits
+                )
+            )
         body.graffiti = bytes(graffiti).ljust(32, b"\x00")[:32]
         body.attestations = tuple(self.op_pool.get_attestations(state))
         prop, att, exits = self.op_pool.get_slashings_and_exits(state)

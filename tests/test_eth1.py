@@ -245,3 +245,64 @@ class TestMockProviderReorg:
         svc.update()
         assert len(svc.deposit_tree.leaves) == 0
         assert [b.hash for b in svc.block_cache] == [provider.blocks[0].hash]
+
+
+class TestEth1VotingAndDepositInclusion:
+    def test_deposit_flows_from_logs_into_produced_block(self):
+        """The full pipeline the reference wires across eth1 + beacon_chain:
+        deposit log -> deposit tree -> eth1-data VOTE accumulates over the
+        voting period -> once a majority lands, the winning vote's owed
+        deposits are packed into the produced block and a new validator
+        joins the registry."""
+        from lighthouse_tpu.crypto.bls import INFINITY_SIGNATURE
+        from lighthouse_tpu.harness.beacon_chain_harness import (
+            BeaconChainHarness,
+        )
+        from lighthouse_tpu.types import interop_secret_key, types_for
+        from lighthouse_tpu.types.containers import block_classes_for
+        from lighthouse_tpu.validator_client.beacon_node import (
+            InProcessBeaconNode,
+        )
+
+        h = BeaconChainHarness(16, MINIMAL)
+        spec = h.spec
+        provider = MockEth1Provider()
+        # the 16 genesis validators' leaves, then ONE new deposit
+        genesis_datas = [
+            make_deposit_data(interop_secret_key(i), 32 * 10**9, spec)
+            for i in range(16)
+        ]
+        provider.add_block(100, genesis_datas)
+        new_sk = SecretKey(999_001)
+        provider.add_block(101, [make_deposit_data(new_sk, 32 * 10**9, spec)])
+        for i in range(6):  # bury past the follow distance
+            provider.add_block(102 + i)
+        svc = Eth1Service(provider)
+        svc.update()
+
+        bn = InProcessBeaconNode(h.chain, eth1_service=svc)
+        t = types_for(MINIMAL)
+        included_at = None
+        for slot in range(1, 20):
+            h.chain.slot_clock.set_slot(slot)
+            block = bn.produce_block(slot, INFINITY_SIGNATURE)
+            _, signed_cls, _ = block_classes_for(
+                t, h.chain.head_state.fork_name
+            )
+            signed = signed_cls(message=block, signature=INFINITY_SIGNATURE)
+            h.chain.process_block(signed, strategy=h.strategy)
+            if len(block.body.deposits) and included_at is None:
+                included_at = slot
+                break
+
+        # majority needs slots_per_eth1_voting_period // 2 + 1 = 17 votes;
+        # the 17th block's own vote wins DURING its processing, so that
+        # very block owes (and carries) the deposit
+        assert included_at == MINIMAL.slots_per_eth1_voting_period // 2 + 1
+        state = h.chain.head_state
+        assert len(state.validators) == 17
+        assert (
+            bytes(state.validators[16].pubkey)
+            == new_sk.public_key().to_bytes()
+        )
+        assert state.eth1_deposit_index == 17

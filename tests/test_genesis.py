@@ -152,3 +152,40 @@ def test_try_genesis_from_eth1_service_waits_for_enough_deposits():
     assert state is not None
     assert len(state.validators) == n
     assert is_valid_genesis_state(state, MINIMAL, SPEC)
+
+
+def test_cli_deposit_contract_genesis_over_real_rpc():
+    """ClientGenesis::DepositContract end-to-end through the CLI builder
+    pieces: an eth1 JSON-RPC rig serves deposit logs over a REAL socket,
+    build_eth1_service polls it, and resolve_genesis waits until the
+    deposits form a valid genesis state."""
+    from types import SimpleNamespace
+
+    from lighthouse_tpu.cli import build_eth1_service, resolve_genesis
+    from lighthouse_tpu.eth1.jsonrpc import Eth1RpcServer
+    from lighthouse_tpu.store.hot_cold import HotColdDB
+    from lighthouse_tpu.store.kv import MemoryStore
+
+    spec = ChainSpec.minimal()
+    spec.min_genesis_active_validator_count = 4
+    provider = MockEth1Provider()
+    provider.add_block(
+        spec.min_genesis_time, [_deposit_data(i) for i in range(4)]
+    )
+    server = Eth1RpcServer(provider)
+    server.start()
+    try:
+        args = SimpleNamespace(
+            eth1_endpoint=server.url,
+            genesis="deposit-contract",
+            genesis_timeout=30.0,
+            datadir=None,
+        )
+        svc = build_eth1_service(args)
+        assert svc is not None
+        store = HotColdDB(MemoryStore(), MINIMAL, spec)
+        chain = resolve_genesis(args, store, MINIMAL, spec, svc)
+        assert len(chain.head_state.validators) == 4
+        assert is_valid_genesis_state(chain.head_state, MINIMAL, spec)
+    finally:
+        server.stop()

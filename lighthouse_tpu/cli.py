@@ -49,12 +49,17 @@ def build_eth1_service(args):
     from .eth1 import Eth1Service
     from .eth1.jsonrpc import JsonRpcEth1Provider
 
+    import sys
+
     provider = JsonRpcEth1Provider(args.eth1_endpoint)
     svc = Eth1Service(provider)
     try:
         svc.update()
-    except Exception:  # noqa: BLE001 -- endpoint flap must not kill startup
-        pass  # the per-slot tick retries
+    except Exception as e:  # noqa: BLE001 -- endpoint flap must not kill startup
+        print(
+            f"warning: eth1 endpoint {args.eth1_endpoint} unreachable: {e}",
+            file=sys.stderr,
+        )
     return svc
 
 
@@ -101,19 +106,37 @@ def resolve_genesis(args, store, preset, spec, eth1_service=None):
             raise SystemExit(
                 "--genesis deposit-contract requires --eth1-endpoint"
             )
-        deadline = time.time() + float(
-            getattr(args, "genesis_timeout", None) or 600.0
+        import sys
+
+        timeout_s = getattr(args, "genesis_timeout", None)
+        deadline = time.time() + (
+            600.0 if timeout_s is None else float(timeout_s)
         )
+        update_failures = 0
         while True:
             state = try_genesis_from_eth1(eth1_service, preset, spec)
             if state is not None:
                 break
             if time.time() > deadline:
-                raise SystemExit("no valid genesis formed before timeout")
+                hint = (
+                    f" ({update_failures} eth1 update failures -- is the "
+                    f"endpoint reachable?)"
+                    if update_failures
+                    else ""
+                )
+                raise SystemExit(
+                    f"no valid genesis formed before timeout{hint}"
+                )
             time.sleep(2.0)
             try:
                 eth1_service.update()
-            except Exception:  # noqa: BLE001 -- keep waiting through flaps
+            except Exception as e:  # noqa: BLE001 -- keep waiting through flaps
+                update_failures += 1
+                if update_failures in (1, 10) or update_failures % 100 == 0:
+                    print(
+                        f"warning: eth1 update failed ({update_failures}x): {e}",
+                        file=sys.stderr,
+                    )
                 continue
         clock = SystemSlotClock(state.genesis_time, spec.seconds_per_slot)
         return BeaconChain(store, state, preset, spec, slot_clock=clock)
@@ -277,7 +300,14 @@ def cmd_vc(args):
             ks = Keystore.from_json(f.read())
         store.add_validator(LocalKeystore(ks.decrypt(args.password or "")))
         count += 1
-    vc = ValidatorClient(store, nodes, preset, spec)
+    vc = ValidatorClient(
+        store,
+        nodes,
+        preset,
+        spec,
+        graffiti=(args.graffiti or "").encode()[:32],
+        graffiti_file=getattr(args, "graffiti_file", None),
+    )
     print(f"validator client: {count} validators, "
           f"{len(args.beacon_nodes)} beacon node(s)")
     if args.dry_run:
@@ -378,8 +408,21 @@ def cmd_db(args):
             return 1
         kv.compact()
         print("compacted")
+    elif args.db_cmd == "prune-payloads":
+        from .store.hot_cold import HotColdDB
+
+        preset, spec = _spec_preset(args)
+        db = HotColdDB(kv, preset, spec)
+        n = db.prune_payloads()
+        print(json.dumps({"pruned_payloads": n}))
     elif args.db_cmd == "version":
-        print("schema version 1")
+        from .store.metadata import CURRENT_SCHEMA_VERSION, get_schema_version
+
+        on_disk = get_schema_version(kv)
+        print(json.dumps({
+            "on_disk": on_disk,
+            "current": CURRENT_SCHEMA_VERSION,
+        }))
     return 0
 
 
@@ -421,6 +464,77 @@ def cmd_tools(args):
         with open(args.file, "rb") as f:
             obj = signed_cls.from_ssz_bytes(f.read())
         print(repr(obj))
+    elif args.tool_cmd == "interop-genesis":
+        # lcli interop-genesis: write a deterministic genesis state
+        from .types import interop_genesis_state
+
+        state = interop_genesis_state(
+            args.validators, preset, spec,
+            genesis_time=args.genesis_time or int(time.time()),
+        )
+        out = args.file or "genesis.ssz"
+        with open(out, "wb") as f:
+            f.write(state.as_ssz_bytes())
+        print(json.dumps({
+            "validators": args.validators,
+            "genesis_time": state.genesis_time,
+            "genesis_validators_root":
+                "0x" + bytes(state.genesis_validators_root).hex(),
+            "path": out,
+        }))
+    elif args.tool_cmd == "new-testnet":
+        # lcli new-testnet: a testnet directory from real deposits
+        # (initialize_beacon_state_from_eth1 over interop keys)
+        import os
+
+        from .eth1.deposit_tree import DepositDataTree
+        from .state_transition.genesis import (
+            initialize_beacon_state_from_eth1,
+        )
+        from .types import interop_keypair
+        from .types.containers import DepositData
+        from .crypto.bls import INFINITY_SIGNATURE
+
+        datas = []
+        tree = DepositDataTree()
+        for i in range(args.validators):
+            _, pk = interop_keypair(i)
+            d = DepositData(
+                pubkey=pk.to_bytes(),
+                withdrawal_credentials=b"\x00" * 32,
+                amount=spec.max_effective_balance,
+                signature=INFINITY_SIGNATURE,
+            )
+            datas.append(d)
+            tree.push(d)
+        deposits = [
+            tree.deposit(i, datas[i], i + 1)
+            for i in range(len(datas))
+        ]
+        from .crypto.bls import set_backend
+
+        set_backend("fake")  # interop deposits carry no possession proofs
+        state = initialize_beacon_state_from_eth1(
+            b"\x42" * 32,
+            args.genesis_time or int(time.time()),
+            deposits,
+            preset,
+            spec,
+        )
+        outdir = args.file or "testnet"
+        os.makedirs(outdir, exist_ok=True)
+        with open(os.path.join(outdir, "genesis.ssz"), "wb") as f:
+            f.write(state.as_ssz_bytes())
+        with open(os.path.join(outdir, "config.json"), "w") as f:
+            json.dump({
+                "config_name": spec.config_name,
+                "preset": preset.name,
+                "validators": args.validators,
+                "genesis_time": state.genesis_time,
+                "genesis_validators_root":
+                    "0x" + bytes(state.genesis_validators_root).hex(),
+            }, f, indent=1)
+        print(json.dumps({"path": outdir, "validators": args.validators}))
     return 0
 
 
@@ -485,6 +599,11 @@ def main(argv=None) -> int:
                     help="range lo..hi of interop keys")
     vc.add_argument("--keystores", nargs="*", default=None)
     vc.add_argument("--password", default=None)
+    vc.add_argument("--graffiti", default=None,
+                    help="default graffiti text for produced blocks")
+    vc.add_argument("--graffiti-file", default=None,
+                    help="per-validator graffiti: '0x<pubkey>: text' "
+                         "lines, 'default: text' fallback")
     vc.add_argument("--dry-run", action="store_true")
     vc.set_defaults(fn=cmd_vc)
 
@@ -509,7 +628,11 @@ def main(argv=None) -> int:
     am.set_defaults(fn=cmd_am)
 
     db = sub.add_parser("db", help="database manager")
-    db.add_argument("db_cmd", choices=["inspect", "compact", "version"])
+    _add_network_args(db)
+    db.add_argument(
+        "db_cmd",
+        choices=["inspect", "compact", "version", "prune-payloads"],
+    )
     db.add_argument("--datadir", required=True)
     db.set_defaults(fn=cmd_db)
 
@@ -517,11 +640,13 @@ def main(argv=None) -> int:
     _add_network_args(tools)
     tools.add_argument("tool_cmd", choices=[
         "skip-slots", "transition-blocks", "pretty-ssz",
+        "interop-genesis", "new-testnet",
     ])
     tools.add_argument("--validators", type=int, default=64)
     tools.add_argument("--slots", type=int, default=8)
     tools.add_argument("--fork", default="phase0")
     tools.add_argument("--file", default=None)
+    tools.add_argument("--genesis-time", type=int, default=None)
     tools.set_defaults(fn=cmd_tools)
 
     args = parser.parse_args(argv)

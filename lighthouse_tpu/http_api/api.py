@@ -233,8 +233,14 @@ class BeaconApi:
             ]
         }
 
-    def produce_block(self, slot: int, randao_reveal: str) -> dict:
-        block = self.node.produce_block(slot, unhex(randao_reveal))
+    def produce_block(
+        self, slot: int, randao_reveal: str, graffiti: str | None = None
+    ) -> dict:
+        block = self.node.produce_block(
+            slot,
+            unhex(randao_reveal),
+            graffiti=unhex(graffiti) if graffiti else b"",
+        )
         return {
             "version": type(block).fork_name,
             "data": {"ssz": hexs(block.as_ssz_bytes())},

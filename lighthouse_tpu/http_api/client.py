@@ -134,10 +134,14 @@ class BeaconNodeHttpClient:
     # -- production / publication -------------------------------------------
 
     def produce_block(self, slot: int, randao_reveal: bytes, graffiti=b""):
-        resp = self._get(
+        url = (
             f"/eth/v2/validator/blocks/{slot}"
             f"?randao_reveal=0x{bytes(randao_reveal).hex()}"
         )
+        if graffiti:
+            padded = bytes(graffiti).ljust(32, b"\x00")[:32]
+            url += f"&graffiti=0x{padded.hex()}"
+        resp = self._get(url)
         from ..types import block_classes_for
 
         t = types_for(self.preset)

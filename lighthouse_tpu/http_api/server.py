@@ -100,7 +100,9 @@ class BeaconApiServer:
                     (
                         r"^/eth/v2/validator/blocks/(\d+)$",
                         lambda m: api.produce_block(
-                            int(m.group(1)), params["randao_reveal"]
+                            int(m.group(1)),
+                            params["randao_reveal"],
+                            graffiti=params.get("graffiti"),
                         ),
                     ),
                     (

@@ -46,16 +46,23 @@ class HotColdDB:
         payload = fork.encode() + b"\x00" + signed_block.as_ssz_bytes()
         self.kv.put(Column.BLOCK, block_root, payload)
 
-    def get_block(self, block_root: bytes):
-        data = self.kv.get(Column.BLOCK, block_root)
-        if data is None:
-            return None
+    def _decode_stored_block(self, data: bytes):
         fork, _, body = data.partition(b"\x00")
         t = types_for(self.preset)
+        if fork == b"bellatrix_blinded":
+            # payload pruned to its header (root-identical to the full
+            # block; database_manager prune-payloads)
+            return t.SignedBlindedBeaconBlock.from_ssz_bytes(body)
         from ..types import block_classes_for
 
         _, signed_cls, _ = block_classes_for(t, fork.decode())
         return signed_cls.from_ssz_bytes(body)
+
+    def get_block(self, block_root: bytes):
+        data = self.kv.get(Column.BLOCK, block_root)
+        if data is None:
+            return None
+        return self._decode_stored_block(data)
 
     # -- states --------------------------------------------------------------
 
@@ -178,9 +185,61 @@ class HotColdDB:
         data = self.kv.get(Column.FREEZER_BLOCK, block_root)
         if data is None:
             return None
-        fork, _, body = data.partition(b"\x00")
-        t = types_for(self.preset)
-        from ..types import block_classes_for
+        return self._decode_stored_block(data)
 
-        _, signed_cls, _ = block_classes_for(t, fork.decode())
-        return signed_cls.from_ssz_bytes(body)
+    def prune_payloads(self, before_slot: int | None = None) -> int:
+        """Replace stored full bellatrix blocks with their BLINDED form
+        (payload -> header; block roots are identical by SSZ design), like
+        `lighthouse db prune-payloads` (database_manager/src/lib.rs).
+        Returns the number of pruned blocks."""
+        from ..state_transition.per_block import payload_to_header
+
+        t = types_for(self.preset)
+        pruned = 0
+        for col in (Column.BLOCK, Column.FREEZER_BLOCK):
+            for root in list(self.kv.keys(col)):
+                data = self.kv.get(col, root)
+                if data is None or not data.startswith(b"bellatrix\x00"):
+                    continue
+                signed = self._decode_stored_block(data)
+                blk = signed.message
+                if before_slot is not None and blk.slot >= before_slot:
+                    continue
+                body = blk.body
+                blinded_body = t.BlindedBeaconBlockBody(
+                    randao_reveal=body.randao_reveal,
+                    eth1_data=body.eth1_data,
+                    graffiti=body.graffiti,
+                    proposer_slashings=body.proposer_slashings,
+                    attester_slashings=body.attester_slashings,
+                    attestations=body.attestations,
+                    deposits=body.deposits,
+                    voluntary_exits=body.voluntary_exits,
+                    sync_aggregate=body.sync_aggregate,
+                    execution_payload_header=payload_to_header(
+                        body.execution_payload, self.preset
+                    ),
+                )
+                blinded = t.BlindedBeaconBlock(
+                    slot=blk.slot,
+                    proposer_index=blk.proposer_index,
+                    parent_root=bytes(blk.parent_root),
+                    state_root=bytes(blk.state_root),
+                    body=blinded_body,
+                )
+                if blinded.tree_hash_root() != blk.tree_hash_root():
+                    # never rewrite a block under a different root (a real
+                    # raise, not an assert: this must survive python -O)
+                    raise RuntimeError(
+                        f"pruned block root diverged for {root.hex()}"
+                    )
+                signed_blinded = t.SignedBlindedBeaconBlock(
+                    message=blinded, signature=bytes(signed.signature)
+                )
+                self.kv.put(
+                    col,
+                    root,
+                    b"bellatrix_blinded\x00" + signed_blinded.as_ssz_bytes(),
+                )
+                pruned += 1
+        return pruned

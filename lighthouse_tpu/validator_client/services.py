@@ -114,11 +114,20 @@ class ValidatorClient:
         nodes: BeaconNodeFallback,
         preset: Preset,
         spec,
+        graffiti: bytes = b"",
+        graffiti_file: str | None = None,
     ):
         self.store = store
         self.nodes = nodes
         self.preset = preset
         self.spec = spec
+        # default graffiti + optional per-validator overrides (reference
+        # --graffiti flag and --graffiti-file: `pubkey: text` lines, with
+        # `default: text` for the fallback)
+        self.graffiti = bytes(graffiti)
+        self.graffiti_overrides: dict[bytes, bytes] = {}
+        if graffiti_file:
+            self._load_graffiti_file(graffiti_file)
         self.duties = DutiesService(store, nodes)
         self.blocks_proposed: list[bytes] = []
         self.attestations_published = 0
@@ -126,6 +135,7 @@ class ValidatorClient:
         self.sync_messages_published = 0
         self.sync_contributions_published = 0
         self.doppelganger_detected: list[bytes] = []
+        self.duty_errors: list[tuple[int, str, str]] = []
         self._dg_start: dict[bytes, int] = {}
         self._prepared_epochs: set[int] = set()
         self._registered_epochs: set[int] = set()
@@ -136,19 +146,57 @@ class ValidatorClient:
                 return pk
         return None
 
+    def _load_graffiti_file(self, path: str) -> None:
+        """`0x<pubkey>: text` per line, `default: text` for the fallback
+        (the reference's GraffitiFile format)."""
+        with open(path) as f:
+            for line in f:
+                line = line.strip()
+                if not line or line.startswith("#"):
+                    continue
+                key, _, text = line.partition(":")
+                text = text.strip().encode()[:32]
+                key = key.strip()
+                if key == "default":
+                    self.graffiti = text
+                else:
+                    pk = bytes.fromhex(key.removeprefix("0x"))
+                    self.graffiti_overrides[pk] = text
+
+    def graffiti_for(self, pubkey: bytes | None) -> bytes:
+        if pubkey is not None and pubkey in self.graffiti_overrides:
+            return self.graffiti_overrides[pubkey]
+        return self.graffiti
+
     # -- per-slot duty execution --------------------------------------------
 
     def on_slot(self, slot: int) -> None:
         epoch = compute_epoch_at_slot(slot, self.preset)
         self.duties.poll(epoch)
         self._doppelganger_scan(epoch)
-        self._preparation_duty(epoch)
-        self._builder_registrations(epoch)
-        self._block_duty(slot)
-        self._attestation_duty(slot)
-        self._sync_committee_duty(slot)
-        self._aggregation_duty(slot)
-        self._sync_aggregation_duty(slot)
+        # one failing duty must never take down the client or starve the
+        # REMAINING duties of the slot (e.g. a BN whose eth1 cache lags
+        # raises Eth1DepositsUnavailable from produce_block at our
+        # proposal slot -- attestations still have to go out)
+        for duty in (
+            self._preparation_duty,
+            self._builder_registrations,
+        ):
+            try:
+                duty(epoch)
+            except Exception as e:  # noqa: BLE001
+                self.duty_errors.append((slot, duty.__name__, str(e)))
+        for duty in (
+            self._block_duty,
+            self._attestation_duty,
+            self._sync_committee_duty,
+            self._aggregation_duty,
+            self._sync_aggregation_duty,
+        ):
+            try:
+                duty(slot)
+            except Exception as e:  # noqa: BLE001
+                self.duty_errors.append((slot, duty.__name__, str(e)))
 
     # -- preparation / fee recipients (preparation_service.rs) ---------------
 
@@ -234,7 +282,9 @@ class ValidatorClient:
         epoch = compute_epoch_at_slot(slot, self.preset)
         try:
             randao = self.store.sign_randao(pubkey, epoch, state)
-            block = node.produce_block(slot, randao.to_bytes())
+            block = node.produce_block(
+                slot, randao.to_bytes(), graffiti=self.graffiti_for(pubkey)
+            )
             sig = self.store.sign_block(pubkey, block, state)
         except (NotSafe, DoppelgangerHold):
             return

@@ -46,11 +46,32 @@ def pytest_configure(config):
 
 
 def pytest_collection_modifyitems(session, config, items):
-    """Run the multichip (8-device SPMD) tests FIRST. Loading/compiling the
-    large sharded executables late in a long pytest process segfaults
-    inside XLA:CPU's executable loader (reproducible at ~60% suite
-    progress; the identical tests pass standalone and when run first),
-    so the big-program tests get the fresh-process slot."""
-    front = [i for i in items if "test_multichip" in str(i.fspath)]
-    rest = [i for i in items if "test_multichip" not in str(i.fspath)]
-    items[:] = front + rest
+    """Run EVERY XLA-compiling test file FIRST, before anything that
+    spawns server/daemon threads. XLA:CPU compilation (and executable
+    deserialization) segfaults non-deterministically late in a long
+    pytest process once network tests have left daemon threads behind --
+    observed three times at ~60-85% progress inside backend_compile /
+    get_executable_and_time, always under an eager kernel call that runs
+    fine standalone or early. Front-loading all compile-heavy files gives
+    them the young-process slot; pure-Python consensus/network tests run
+    after."""
+    compile_heavy = (
+        "test_multichip",  # biggest programs: keep the freshest slot
+        "test_tpu_",
+        "test_pallas_kernels",
+        "test_bls_api",
+        "test_bls_edge_matrix",
+        "test_pubkey_table",
+        "test_known_vectors",
+        "test_ef_vectors",
+        "test_pipeline",
+    )
+
+    def rank(item):
+        path = str(item.fspath)
+        for i, frag in enumerate(compile_heavy):
+            if frag in path:
+                return i
+        return len(compile_heavy)
+
+    items.sort(key=rank)

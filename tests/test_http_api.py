@@ -100,6 +100,7 @@ class TestVcOverHttp:
         vc = ValidatorClient(
             store, BeaconNodeFallback([client]), MINIMAL, h.spec
         )
+        vc.graffiti = b"over http"  # must survive the HTTP process boundary
         for slot in range(1, MINIMAL.slots_per_epoch + 1):
             h.chain.slot_clock.set_slot(slot)
             h.chain.on_tick()
@@ -107,6 +108,9 @@ class TestVcOverHttp:
         assert h.chain.head_state.slot == MINIMAL.slots_per_epoch
         assert len(vc.blocks_proposed) == MINIMAL.slots_per_epoch
         assert vc.attestations_published >= 16
+        assert not vc.duty_errors, vc.duty_errors
+        head = h.store.get_block(h.chain.head_root)
+        assert bytes(head.message.body.graffiti).rstrip(b"\x00") == b"over http"
 
 
 class TestWidenedRoutes:

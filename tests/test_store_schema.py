@@ -77,3 +77,64 @@ def test_v1_to_v2_block_migration():
     before = kv.get(Column.BLOCK, root)
     _migrate_v1_to_v2(kv, MINIMAL)
     assert kv.get(Column.BLOCK, root) == before
+
+
+class TestPrunePayloads:
+    def test_prune_payloads_blinds_bellatrix_blocks(self):
+        """`lighthouse db prune-payloads` (database_manager): stored full
+        bellatrix blocks become blinded (payload -> header) with IDENTICAL
+        block roots, remain decodable, and still replay through the state
+        transition."""
+        from lighthouse_tpu.execution_layer import (
+            ExecutionLayer,
+            MockExecutionEngine,
+        )
+        from lighthouse_tpu.harness import BeaconChainHarness
+        from lighthouse_tpu.types import ChainSpec, types_for
+
+        t = types_for(MINIMAL)
+        engine = MockExecutionEngine(t)
+        el = ExecutionLayer(engine)
+        spec = ChainSpec.interop(altair_fork_epoch=1, bellatrix_fork_epoch=2)
+        h = BeaconChainHarness(
+            16, MINIMAL, spec, sign=False, execution_layer=el
+        )
+        h.extend_chain(2 * MINIMAL.slots_per_epoch + 3)
+        assert h.chain.head_state.fork_name == "bellatrix"
+        head_root = h.chain.head_root
+        full = h.store.get_block(head_root)
+        assert hasattr(full.message.body, "execution_payload")
+
+        n = h.store.prune_payloads()
+        assert n >= 3  # the bellatrix blocks
+        blinded = h.store.get_block(head_root)
+        assert hasattr(blinded.message.body, "execution_payload_header")
+        assert (
+            blinded.message.tree_hash_root()
+            == full.message.tree_hash_root()
+        )
+        # a pruned block still replays (blinded-body state transition)
+        from lighthouse_tpu.state_transition import (
+            BlockSignatureStrategy,
+            clone_state,
+            per_block_processing,
+            process_slots,
+        )
+
+        parent_state = h.chain._states[
+            bytes(blinded.message.parent_root)
+        ]
+        st = process_slots(
+            clone_state(parent_state),
+            blinded.message.slot,
+            MINIMAL,
+            spec,
+        )
+        per_block_processing(
+            st,
+            blinded,
+            MINIMAL,
+            spec,
+            strategy=BlockSignatureStrategy.NO_VERIFICATION,
+        )
+        assert st.slot == blinded.message.slot

@@ -136,6 +136,47 @@ class TestDutyLoop:
         )
         assert total_packed > 0
 
+    def test_graffiti_flag_and_per_validator_file(self, tmp_path):
+        """--graffiti sets the default; --graffiti-file overrides per
+        pubkey (reference GraffitiFile)."""
+        h = BeaconChainHarness(16, MINIMAL, ChainSpec.interop())
+        node = InProcessBeaconNode(h.chain)
+        store = ValidatorStore(MINIMAL, h.spec)
+        for i in range(16):
+            store.add_validator(LocalKeystore(interop_secret_key(i)))
+        special_pk = interop_secret_key(0).public_key().to_bytes()
+        gfile = tmp_path / "graffiti.txt"
+        gfile.write_text(
+            f"0x{special_pk.hex()}: special one\n"
+            "default: from the file\n"
+        )
+        vc = ValidatorClient(
+            store,
+            BeaconNodeFallback([node]),
+            MINIMAL,
+            h.spec,
+            graffiti=b"flag default",
+            graffiti_file=str(gfile),
+        )
+        # the file's default overrides the flag; the pubkey line overrides both
+        assert vc.graffiti_for(None) == b"from the file"
+        assert vc.graffiti_for(special_pk) == b"special one"
+        seen = {}
+        for slot in range(1, MINIMAL.slots_per_epoch + 1):
+            h.chain.slot_clock.set_slot(slot)
+            h.chain.on_tick()
+            vc.on_slot(slot)
+        for r in vc.blocks_proposed:
+            block = h.store.get_block(r).message
+            g = bytes(block.body.graffiti).rstrip(b"\x00")
+            proposer_pk = interop_secret_key(
+                block.proposer_index
+            ).public_key().to_bytes()
+            seen[proposer_pk] = g
+        for pk, g in seen.items():
+            want = b"special one" if pk == special_pk else b"from the file"
+            assert g == want
+
     def test_slashing_protection_blocks_equivocation(self):
         h, node, vc = make_vc(validators=16, register=16)
         h.chain.slot_clock.set_slot(1)

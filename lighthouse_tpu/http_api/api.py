@@ -678,8 +678,102 @@ class BeaconApi:
                 return {"data": p}
         raise ApiError(404, "peer not found")
 
+    def get_peer_count(self) -> dict:
+        peers = self.get_peers()["data"]
+        connected = sum(1 for p in peers if p["state"] == "connected")
+        return {
+            "data": {
+                "connected": str(connected),
+                "disconnected": str(len(peers) - connected),
+                "connecting": "0",
+                "disconnecting": "0",
+            }
+        }
+
     def get_health(self) -> int:
         return 200 if self.node.is_healthy() else 503
+
+    def get_state_randao(self, state_id: str, epoch: int | None = None) -> dict:
+        """GET /eth/v1/beacon/states/{id}/randao. Epochs outside the
+        state's randao history window are a 400, not a silently wrapped
+        stale mix."""
+        from ..types.helpers import get_randao_mix
+
+        state = self._state(state_id)
+        current = state.slot // self.chain.preset.slots_per_epoch
+        target = epoch if epoch is not None else current
+        window = self.chain.preset.epochs_per_historical_vector
+        if target > current or current - target >= window:
+            raise ApiError(400, "epoch outside the randao history window")
+        mix = get_randao_mix(state, target, self.chain.preset)
+        return {"data": {"randao": hexs(mix)}}
+
+    def get_headers(self, slot: int | None = None) -> dict:
+        """GET /eth/v1/beacon/headers (canonical head, or by slot; a
+        SKIPPED slot returns an empty list, per the Beacon API)."""
+        from ..types.containers import header_from_block
+
+        if slot is None:
+            roots = [self.chain.head_root]
+        else:
+            head_slot = int(self.chain.head_state.slot)
+            if slot > head_slot or head_slot - slot > 256:
+                roots = []
+            else:
+                # exact-slot match only: the parent walk never invents a
+                # block for an empty slot (block_roots back-fill would)
+                roots = [
+                    root
+                    for root, blk in self._canonical_blocks_in_range(
+                        slot, slot
+                    )
+                    if blk.message.slot == slot
+                ]
+        out = []
+        for root in roots:
+            signed = self.node.chain.store.get_block_any_temperature(root)
+            if signed is None:
+                continue
+            hdr = header_from_block(signed.message)
+            out.append(
+                {
+                    "root": hexs(root),
+                    "canonical": True,
+                    "header": {
+                        "message": {
+                            "slot": str(hdr.slot),
+                            "proposer_index": str(hdr.proposer_index),
+                            "parent_root": hexs(hdr.parent_root),
+                            "state_root": hexs(hdr.state_root),
+                            "body_root": hexs(hdr.body_root),
+                        },
+                        "signature": hexs(signed.signature),
+                    },
+                }
+            )
+        return {"data": out}
+
+    def subscribe_beacon_committee(self, subscriptions: list) -> dict:
+        """POST /eth/v1/validator/beacon_committee_subscriptions: forward
+        duty subnet subscriptions to the attestation subnet service."""
+        svc = (
+            getattr(self.network, "subnet_service", None)
+            if self.network
+            else None
+        )
+        if svc is not None:
+            for sub in subscriptions:
+                svc.subscribe_for_duty(
+                    int(sub["slot"]),
+                    int(sub["committees_at_slot"]),
+                    int(sub["committee_index"]),
+                )
+        return {"data": None}
+
+    def subscribe_sync_committee(self, subscriptions: list) -> dict:
+        """POST /eth/v1/validator/sync_committee_subscriptions (accepted;
+        sync subnets are always-on in this node)."""
+        return {"data": None}
 
     # -- /lighthouse/* extensions (reference http_api's lighthouse
     #    namespace: validator-inclusion, block-packing-efficiency,
@@ -811,18 +905,13 @@ class BeaconApi:
                 counts["exited"] += 1
         return {"data": {k: str(n) for k, n in counts.items()}}
 
-    def lighthouse_block_packing(self, start_slot: int, end_slot: int) -> dict:
-        """Per-block packing efficiency over a canonical slot range
-        (block_packing_efficiency.rs): unique attester coverage each block
-        actually included."""
-        head_slot = int(self.chain.head_state.slot)
-        if end_slot - start_slot > 256 or head_slot - start_slot > 256:
-            # bounds the parent WALK, not just the output: the walk runs
-            # from the head down to start_slot
-            raise ApiError(
-                400, "range too wide (max 256 slots, within 256 of head)"
-            )
-        out = []
+    def _canonical_blocks_in_range(
+        self, start_slot: int, end_slot: int
+    ) -> list:
+        """Canonical (root, signed_block) pairs with start <= slot <= end,
+        oldest first, via the parent walk from the head. The walk runs
+        from the head down to start_slot, so callers must bound the range
+        BEFORE calling."""
         root = self.chain.head_root
         blocks = []
         while root is not None:
@@ -837,7 +926,20 @@ class BeaconApi:
             if not any(parent):
                 break
             root = parent
-        for root, blk in reversed(blocks):
+        blocks.reverse()
+        return blocks
+
+    def lighthouse_block_packing(self, start_slot: int, end_slot: int) -> dict:
+        """Per-block packing efficiency over a canonical slot range
+        (block_packing_efficiency.rs): unique attester coverage each block
+        actually included."""
+        head_slot = int(self.chain.head_state.slot)
+        if end_slot - start_slot > 256 or head_slot - start_slot > 256:
+            raise ApiError(
+                400, "range too wide (max 256 slots, within 256 of head)"
+            )
+        out = []
+        for root, blk in self._canonical_blocks_in_range(start_slot, end_slot):
             atts = blk.message.body.attestations
             unique = set()
             for att in atts:
@@ -851,6 +953,60 @@ class BeaconApi:
                     "block_root": hexs(root),
                     "attestations_included": len(atts),
                     "attester_slots_covered": len(unique),
+                }
+            )
+        return {"data": out}
+
+    def lighthouse_block_rewards(self, start_slot: int, end_slot: int) -> dict:
+        """Per-block proposer reward over a canonical slot range
+        (block_rewards.rs): replay each block on its parent state and
+        report the proposer's balance delta (at non-boundary slots the
+        only thing moving the proposer's balance is the block itself:
+        attestation-inclusion, sync-aggregate, and slashing rewards).
+        Exact from altair on, where the spec pays proposers at block
+        processing; phase0 pays attestation-inclusion rewards at epoch
+        processing, so phase0 rows report only the immediate (slashing)
+        component."""
+        from ..state_transition import (
+            BlockSignatureStrategy,
+            clone_state,
+            per_block_processing,
+            process_slots,
+        )
+
+        head_slot = int(self.chain.head_state.slot)
+        if end_slot - start_slot > 64 or head_slot - start_slot > 256:
+            raise ApiError(
+                400, "range too wide (max 64 slots, within 256 of head)"
+            )
+        out = []
+        for root, blk in self._canonical_blocks_in_range(start_slot, end_slot):
+            parent_state = self.chain._states.get(
+                bytes(blk.message.parent_root)
+            )
+            if parent_state is None:
+                continue  # pre-finalization parents: replay not retained
+            st = process_slots(
+                clone_state(parent_state),
+                blk.message.slot,
+                self.chain.preset,
+                self.chain.spec,
+            )
+            proposer = blk.message.proposer_index
+            before = st.balances[proposer]
+            per_block_processing(
+                st,
+                blk,
+                self.chain.preset,
+                self.chain.spec,
+                strategy=BlockSignatureStrategy.NO_VERIFICATION,
+            )
+            out.append(
+                {
+                    "slot": str(blk.message.slot),
+                    "block_root": hexs(root),
+                    "proposer_index": str(proposer),
+                    "total_reward": str(st.balances[proposer] - before),
                 }
             )
         return {"data": out}

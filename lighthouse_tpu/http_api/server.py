@@ -122,6 +122,23 @@ class BeaconApiServer:
                     (r"^/eth/v1/node/identity$", lambda m: api.get_identity()),
                     (r"^/eth/v1/node/peers$", lambda m: api.get_peers()),
                     (
+                        r"^/eth/v1/node/peer_count$",
+                        lambda m: api.get_peer_count(),
+                    ),
+                    (
+                        r"^/eth/v1/beacon/states/([^/]+)/randao$",
+                        lambda m: api.get_state_randao(
+                            m.group(1),
+                            int(params["epoch"]) if "epoch" in params else None,
+                        ),
+                    ),
+                    (
+                        r"^/eth/v1/beacon/headers$",
+                        lambda m: api.get_headers(
+                            int(params["slot"]) if "slot" in params else None
+                        ),
+                    ),
+                    (
                         r"^/eth/v1/node/peers/([^/]+)$",
                         lambda m: api.get_peer(m.group(1)),
                     ),
@@ -231,6 +248,12 @@ class BeaconApiServer:
                             int(params["start_slot"]), int(params["end_slot"])
                         ),
                     ),
+                    (
+                        r"^/lighthouse/analysis/block_rewards$",
+                        lambda m: api.lighthouse_block_rewards(
+                            int(params["start_slot"]), int(params["end_slot"])
+                        ),
+                    ),
                 ]
                 routes_post = [
                     (
@@ -256,6 +279,14 @@ class BeaconApiServer:
                     (
                         r"^/eth/v1/validator/prepare_beacon_proposer$",
                         lambda m: api.prepare_beacon_proposer(self._body()),
+                    ),
+                    (
+                        r"^/eth/v1/validator/beacon_committee_subscriptions$",
+                        lambda m: api.subscribe_beacon_committee(self._body()),
+                    ),
+                    (
+                        r"^/eth/v1/validator/sync_committee_subscriptions$",
+                        lambda m: api.subscribe_sync_committee(self._body()),
                     ),
                     (
                         r"^/eth/v1/beacon/pool/voluntary_exits$",

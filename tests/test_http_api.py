@@ -117,6 +117,53 @@ class TestWidenedRoutes:
     """VERDICT r3 weak-7: node/peers, config/spec, debug, pool, committee,
     and sync-committee routes (reference http_api/src/lib.rs coverage)."""
 
+    def test_randao_headers_peer_count_and_subscriptions(self, rig):
+        h, node, server, client = rig
+        h.extend_chain(2)
+        randao = client._get("/eth/v1/beacon/states/head/randao")["data"]
+        assert randao["randao"].startswith("0x") and len(randao["randao"]) == 66
+        count = client._get("/eth/v1/node/peer_count")["data"]
+        assert set(count) >= {"connected", "disconnected"}
+        headers = client._get("/eth/v1/beacon/headers")["data"]
+        assert len(headers) == 1
+        assert headers[0]["root"] == "0x" + h.chain.head_root.hex()
+        slot = int(headers[0]["header"]["message"]["slot"])
+        by_slot = client._get(f"/eth/v1/beacon/headers?slot={slot - 1}")["data"]
+        assert len(by_slot) == 1
+        parent = headers[0]["header"]["message"]["parent_root"]
+        assert by_slot[0]["root"] == parent
+        # the HEAD slot itself must resolve (review finding)...
+        at_head = client._get(f"/eth/v1/beacon/headers?slot={slot}")["data"]
+        assert [r["root"] for r in at_head] == [headers[0]["root"]]
+        # ...and a SKIPPED slot must be empty, not the previous block
+        h.add_block_at_slot(slot + 2)  # leaves slot+1 empty
+        skipped = client._get(f"/eth/v1/beacon/headers?slot={slot + 1}")["data"]
+        assert skipped == []
+        # randao: future epochs are a 400, not wrapped garbage
+        from lighthouse_tpu.http_api.client import Eth2ClientError
+
+        with pytest.raises(Eth2ClientError):
+            client._get("/eth/v1/beacon/states/head/randao?epoch=999")
+        # subscriptions are accepted over the wire (no subnet service on
+        # the in-process rig: still a 200 with null data)
+        resp = client._post(
+            "/eth/v1/validator/beacon_committee_subscriptions",
+            [
+                {
+                    "validator_index": "0",
+                    "committee_index": "0",
+                    "committees_at_slot": "1",
+                    "slot": str(slot + 1),
+                    "is_aggregator": False,
+                }
+            ],
+        )
+        assert resp["data"] is None
+        resp = client._post(
+            "/eth/v1/validator/sync_committee_subscriptions", []
+        )
+        assert resp["data"] is None
+
     def test_config_namespace(self, rig):
         h, node, server, client = rig
         spec = client.spec()
@@ -292,3 +339,28 @@ class TestLighthouseExtensions:
         assert len(rows) == 5
         # harness blocks include full-participation attestations
         assert all(int(r["attester_slots_covered"]) > 0 for r in rows[1:])
+
+    def test_block_rewards_analysis(self):
+        # altair rig: proposer rewards are paid AT block processing there
+        # (phase0 defers attestation-inclusion rewards to the epoch)
+        h = BeaconChainHarness(
+            16, MINIMAL, ChainSpec.interop(altair_fork_epoch=0)
+        )
+        node = InProcessBeaconNode(h.chain)
+        api = BeaconApi(node)
+        server = BeaconApiServer(api)
+        server.start()
+        try:
+            client = BeaconNodeHttpClient(
+                f"http://127.0.0.1:{server.port}", MINIMAL
+            )
+            h.extend_chain(6)
+            rows = client._get(
+                "/lighthouse/analysis/block_rewards?start_slot=2&end_slot=6"
+            )["data"]
+            assert len(rows) == 5
+            # blocks packing attestations earn proposer inclusion rewards
+            assert all(int(r["total_reward"]) > 0 for r in rows)
+            assert all(r["block_root"].startswith("0x") for r in rows)
+        finally:
+            server.stop()

@@ -234,3 +234,149 @@ def verify_next_committee_branch(update) -> None:
         bytes(update.attested_header.state_root),
     ):
         raise LightClientError("next sync committee branch does not verify")
+
+
+# -- the following light client ----------------------------------------------
+
+
+class LightClientStore:
+    """Spec light-client store (altair sync protocol): installs from a
+    trusted bootstrap, then follows updates by verifying the SYNC
+    AGGREGATE SIGNATURE over the attested header (the crypto a light
+    client actually trusts), the supermajority rule, the finality and
+    next-committee branches, and rotating committees across periods."""
+
+    def __init__(
+        self, trusted_block_root: bytes, bootstrap, preset, spec,
+        genesis_validators_root: bytes,
+    ):
+        verify_bootstrap(bootstrap, trusted_block_root)
+        self.preset = preset
+        self.spec = spec
+        self.genesis_validators_root = bytes(genesis_validators_root)
+        self.finalized_header = bootstrap.header
+        self.optimistic_header = bootstrap.header
+        self.current_sync_committee = bootstrap.current_sync_committee
+        self.next_sync_committee = None
+
+    def _period_of(self, slot: int) -> int:
+        return slot // (
+            self.preset.slots_per_epoch
+            * self.preset.epochs_per_sync_committee_period
+        )
+
+    def _verify_sync_aggregate(self, update) -> None:
+        from ..crypto.bls import (
+            PublicKey,
+            Signature,
+            SignatureSet,
+            verify_signature_sets,
+        )
+        from ..types.chain_spec import DOMAIN_SYNC_COMMITTEE
+        from ..types.containers import SigningData
+        from ..types.helpers import compute_domain, compute_epoch_at_slot
+
+        bits = list(update.sync_aggregate.sync_committee_bits)
+        n = sum(bits)
+        if 3 * n < 2 * len(bits):
+            raise LightClientError(
+                f"insufficient sync participation {n}/{len(bits)}"
+            )
+        sig_slot = int(update.signature_slot)
+        if sig_slot <= int(update.attested_header.slot):
+            raise LightClientError("signature slot not after attested slot")
+        sig_period = self._period_of(sig_slot)
+        store_period = self._period_of(int(self.finalized_header.slot))
+        if sig_period == store_period:
+            committee = self.current_sync_committee
+        elif (
+            sig_period == store_period + 1
+            and self.next_sync_committee is not None
+        ):
+            committee = self.next_sync_committee
+        else:
+            raise LightClientError(
+                f"no committee known for period {sig_period}"
+            )
+        pubkeys = [
+            PublicKey.from_bytes(bytes(pk))
+            for pk, bit in zip(committee.pubkeys, bits)
+            if bit
+        ]
+        # the aggregate signs the attested header root in the slot BEFORE
+        # the signature slot (spec get_sync_committee_message domain)
+        epoch = compute_epoch_at_slot(max(sig_slot, 1) - 1, self.preset)
+        domain = compute_domain(
+            DOMAIN_SYNC_COMMITTEE,
+            self.spec.fork_version_at_epoch(epoch),
+            self.genesis_validators_root,
+        )
+        root = SigningData(
+            object_root=update.attested_header.tree_hash_root(),
+            domain=domain,
+        ).tree_hash_root()
+        ok = verify_signature_sets(
+            [
+                SignatureSet.multiple_pubkeys(
+                    Signature.from_bytes(
+                        bytes(update.sync_aggregate.sync_committee_signature)
+                    ),
+                    pubkeys,
+                    root,
+                )
+            ]
+        )
+        if not ok:
+            raise LightClientError("sync aggregate signature invalid")
+
+    def process_update(self, update) -> None:
+        """Full LightClientUpdate: signature + finality + committee
+        rotation (spec process_light_client_update, reduced to the
+        immediate-apply path -- every served update carries a verified
+        finality proof)."""
+        self._verify_sync_aggregate(update)
+        verify_finality_branch(update)
+        has_next = any(bytes(h) != bytes(32) for h in update.next_sync_committee_branch)
+        if has_next:
+            verify_next_committee_branch(update)
+        att_period = self._period_of(int(update.attested_header.slot))
+        store_period = self._period_of(int(self.finalized_header.slot))
+        if has_next and att_period == store_period:
+            self.next_sync_committee = update.next_sync_committee
+        if int(update.finalized_header.slot) > int(self.finalized_header.slot):
+            new_period = self._period_of(int(update.finalized_header.slot))
+            if new_period > store_period:
+                if self.next_sync_committee is None:
+                    raise LightClientError(
+                        "cannot cross a period without the next committee"
+                    )
+                self.current_sync_committee = self.next_sync_committee
+                self.next_sync_committee = (
+                    update.next_sync_committee if has_next else None
+                )
+            self.finalized_header = update.finalized_header
+        if int(update.attested_header.slot) > int(self.optimistic_header.slot):
+            self.optimistic_header = update.attested_header
+
+    def process_finality_update(self, update) -> None:
+        """LightClientFinalityUpdate: signature + finality proof, no
+        committee payload."""
+        self._verify_sync_aggregate(update)
+        verify_finality_branch(update)
+        if int(update.finalized_header.slot) > int(self.finalized_header.slot):
+            if self._period_of(
+                int(update.finalized_header.slot)
+            ) > self._period_of(int(self.finalized_header.slot)):
+                raise LightClientError(
+                    "finality update crosses a period; need a full update"
+                )
+            self.finalized_header = update.finalized_header
+        if int(update.attested_header.slot) > int(self.optimistic_header.slot):
+            self.optimistic_header = update.attested_header
+
+    def process_optimistic_update(self, update) -> None:
+        """LightClientOptimisticUpdate: signature only; advances the
+        optimistic head."""
+        self._verify_sync_aggregate(update)
+        if int(update.attested_header.slot) > int(self.optimistic_header.slot):
+            self.optimistic_header = update.attested_header

@@ -703,7 +703,7 @@ class BeaconApi:
         current = state.slot // self.chain.preset.slots_per_epoch
         target = epoch if epoch is not None else current
         window = self.chain.preset.epochs_per_historical_vector
-        if target > current or current - target >= window:
+        if target < 0 or target > current or current - target >= window:
             raise ApiError(400, "epoch outside the randao history window")
         mix = get_randao_mix(state, target, self.chain.preset)
         return {"data": {"randao": hexs(mix)}}
@@ -714,26 +714,31 @@ class BeaconApi:
         from ..types.containers import header_from_block
 
         if slot is None:
-            roots = [self.chain.head_root]
+            root = self.chain.head_root
+            signed = self.chain.store.get_block_any_temperature(root)
+            pairs = [(root, signed)] if signed is not None else []
         else:
             head_slot = int(self.chain.head_state.slot)
-            if slot > head_slot or head_slot - slot > 256:
-                roots = []
+            if slot > head_slot:
+                pairs = []
+            elif head_slot - slot > 256:
+                # distinguish "beyond the bounded walk" from "skipped
+                # slot": an empty list here would misreport real blocks
+                raise ApiError(
+                    400, "slot more than 256 behind head (walk bound)"
+                )
             else:
                 # exact-slot match only: the parent walk never invents a
                 # block for an empty slot (block_roots back-fill would)
-                roots = [
-                    root
+                pairs = [
+                    (root, blk)
                     for root, blk in self._canonical_blocks_in_range(
                         slot, slot
                     )
                     if blk.message.slot == slot
                 ]
         out = []
-        for root in roots:
-            signed = self.node.chain.store.get_block_any_temperature(root)
-            if signed is None:
-                continue
+        for root, signed in pairs:
             hdr = header_from_block(signed.message)
             out.append(
                 {

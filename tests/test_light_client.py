@@ -219,3 +219,117 @@ class TestFinalizedBootstrap:
         assert state is not None
         b = light_client_bootstrap(state, MINIMAL)
         verify_bootstrap(b, old_root)
+
+
+class TestLightClientStore:
+    def test_following_store_verifies_signatures_and_advances(self):
+        """The full light-client trust path: bootstrap at a finalized
+        root, then a finality update whose sync-aggregate SIGNATURE is
+        verified against the committee (real crypto, CPU oracle) before
+        headers advance. Tampering and insufficient participation are
+        rejected."""
+        from lighthouse_tpu.chain.light_client import (
+            LightClientStore,
+            light_client_bootstrap,
+            light_client_finality_update,
+        )
+        from lighthouse_tpu.crypto.bls import (
+            AggregateSignature,
+            set_backend,
+        )
+        from lighthouse_tpu.types import interop_secret_key, types_for
+        from lighthouse_tpu.types.chain_spec import DOMAIN_SYNC_COMMITTEE
+        from lighthouse_tpu.types.containers import (
+            SigningData,
+            header_from_block,
+        )
+        from lighthouse_tpu.types.helpers import (
+            compute_domain,
+            compute_epoch_at_slot,
+        )
+
+        set_backend("cpu")
+        try:
+            h = altair_chain(epochs=4)
+            state = h.chain.head_state
+            fin_root = bytes(state.finalized_checkpoint.root)
+            fin_block = h.chain.store.get_block_any_temperature(fin_root)
+            fin_state = h.chain._states.get(fin_root)
+            if fin_state is None:
+                fin_state = state  # committees are stable across periods here
+            boot = light_client_bootstrap(fin_state, MINIMAL)
+            # align the bootstrap header with the trusted root
+            boot.header = header_from_block(fin_block.message)
+            store = LightClientStore(
+                fin_block.message.tree_hash_root(),
+                boot,
+                MINIMAL,
+                h.spec,
+                bytes(state.genesis_validators_root),
+            )
+
+            fin_header = header_from_block(fin_block.message)
+            sig_slot = int(state.slot) + 1
+            u = light_client_finality_update(
+                state, fin_header, _empty_agg(), sig_slot, MINIMAL
+            )
+            # sign the attested header with the REAL sync committee keys
+            epoch = compute_epoch_at_slot(sig_slot - 1, MINIMAL)
+            domain = compute_domain(
+                DOMAIN_SYNC_COMMITTEE,
+                h.spec.fork_version_at_epoch(epoch),
+                bytes(state.genesis_validators_root),
+            )
+            root = SigningData(
+                object_root=u.attested_header.tree_hash_root(), domain=domain
+            ).tree_hash_root()
+            sk_by_pk = {
+                interop_secret_key(i).public_key().to_bytes(): (
+                    interop_secret_key(i)
+                )
+                for i in range(16)
+            }
+            sigs = [
+                sk_by_pk[bytes(pk)].sign(root)
+                for pk in state.current_sync_committee.pubkeys
+            ]
+            agg = types_for(MINIMAL).SyncAggregate(
+                sync_committee_bits=[True]
+                * len(list(state.current_sync_committee.pubkeys)),
+                sync_committee_signature=AggregateSignature.aggregate(
+                    sigs
+                ).to_bytes(),
+            )
+            u.sync_aggregate = agg
+
+            store.process_finality_update(u)
+            assert (
+                store.optimistic_header.tree_hash_root()
+                == u.attested_header.tree_hash_root()
+            )
+            assert int(store.finalized_header.slot) == int(fin_header.slot)
+
+            # a tampered attested header breaks the signature
+            bad = light_client_finality_update(
+                state, fin_header, agg, sig_slot, MINIMAL
+            )
+            bad.attested_header.proposer_index = (
+                int(bad.attested_header.proposer_index) + 1
+            )
+            with pytest.raises(LightClientError):
+                store.process_finality_update(bad)
+
+            # insufficient participation is rejected before crypto
+            thin = types_for(MINIMAL).SyncAggregate(
+                sync_committee_bits=[True] * 10
+                + [False]
+                * (len(list(state.current_sync_committee.pubkeys)) - 10),
+                sync_committee_signature=agg.sync_committee_signature,
+            )
+            u_thin = light_client_finality_update(
+                state, fin_header, thin, sig_slot, MINIMAL
+            )
+            with pytest.raises(LightClientError):
+                store.process_finality_update(u_thin)
+        finally:
+            set_backend("fake")

@@ -258,6 +258,10 @@ class LightClientStore:
         self.optimistic_header = bootstrap.header
         self.current_sync_committee = bootstrap.current_sync_committee
         self.next_sync_committee = None
+        # parsed-pubkey cache keyed by committee root: the committee is
+        # fixed for a whole sync period (8192 slots on mainnet), so the
+        # per-update deserialization of up to 512 keys amortizes to zero
+        self._parsed_committees: dict[bytes, list] = {}
 
     def _period_of(self, slot: int) -> int:
         return slot // (
@@ -298,11 +302,14 @@ class LightClientStore:
             raise LightClientError(
                 f"no committee known for period {sig_period}"
             )
-        pubkeys = [
-            PublicKey.from_bytes(bytes(pk))
-            for pk, bit in zip(committee.pubkeys, bits)
-            if bit
-        ]
+        committee_root = committee.tree_hash_root()
+        parsed = self._parsed_committees.get(committee_root)
+        if parsed is None:
+            parsed = [
+                PublicKey.from_bytes(bytes(pk)) for pk in committee.pubkeys
+            ]
+            self._parsed_committees = {committee_root: parsed}  # keep 1
+        pubkeys = [pk for pk, bit in zip(parsed, bits) if bit]
         # the aggregate signs the attested header root in the slot BEFORE
         # the signature slot (spec get_sync_committee_message domain)
         epoch = compute_epoch_at_slot(max(sig_slot, 1) - 1, self.preset)

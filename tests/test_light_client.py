@@ -255,8 +255,10 @@ class TestLightClientStore:
             fin_root = bytes(state.finalized_checkpoint.root)
             fin_block = h.chain.store.get_block_any_temperature(fin_root)
             fin_state = h.chain._states.get(fin_root)
-            if fin_state is None:
-                fin_state = state  # committees are stable across periods here
+            assert fin_state is not None, (
+                "finalized state evicted: bootstrap needs the state whose "
+                "root the finalized header commits to"
+            )
             boot = light_client_bootstrap(fin_state, MINIMAL)
             # align the bootstrap header with the trusted root
             boot.header = header_from_block(fin_block.message)

@@ -135,6 +135,19 @@ class BeaconChain:
         from .early_attester_cache import EarlyAttesterCache
 
         self.early_attester_cache = EarlyAttesterCache()
+        # merge-transition blocks imported before their pow data was
+        # available: block_root -> payload parent hash, re-checked each
+        # tick (otb_verification_service.rs). PERSISTED: a pending TTD
+        # re-verification must survive a restart, or the node permanently
+        # follows an invalid payload subtree.
+        self.optimistic_transition_blocks: dict[bytes, bytes] = {}
+        from ..store.kv import Column as _Col
+
+        for key in self.store.kv.keys(_Col.CHAIN):
+            if bytes(key).startswith(b"otb:"):
+                parent = self.store.get_chain_item(key)
+                if parent:
+                    self.optimistic_transition_blocks[bytes(key)[4:]] = parent
 
     def emit(self, kind: str, payload: dict) -> None:
         for sink in self.event_sinks:
@@ -211,6 +224,37 @@ class BeaconChain:
 
     def on_tick(self) -> None:
         self.fork_choice.on_tick(self.current_slot)
+        self.verify_optimistic_transition_blocks()
+
+    def verify_optimistic_transition_blocks(self) -> None:
+        """Re-check merge-transition blocks imported while their pow data
+        was unavailable (otb_verification_service.rs): once the EL can
+        serve the pow chain, a TTD-invalid transition block invalidates
+        its payload subtree in fork choice."""
+        if self.execution_layer is None:
+            return
+        from ..store.kv import Column as _Col
+
+        for root, parent_hash in list(
+            self.optimistic_transition_blocks.items()
+        ):
+            if root not in self.fork_choice.proto.proto_array.indices:
+                # pruned out of fork choice (finalized past, or already
+                # discarded): nothing left to re-verify -- without this,
+                # an engine with no pow surface re-polls forever
+                del self.optimistic_transition_blocks[root]
+                self.store.kv.delete(_Col.CHAIN, b"otb:" + root)
+                continue
+            verdict = self.execution_layer.validate_merge_block(
+                parent_hash, self.spec
+            )
+            if verdict is None:
+                continue  # still no pow data; keep waiting
+            del self.optimistic_transition_blocks[root]
+            self.store.kv.delete(_Col.CHAIN, b"otb:" + root)
+            if verdict is False:
+                self.fork_choice.on_invalid_execution_payload(root)
+                self.recompute_head()
 
     # -- block import (beacon_chain.rs:2520 process_block) ------------------
 
@@ -279,6 +323,27 @@ class BeaconChain:
                     state, block.slot, self.preset, self.spec
                 )
         ctxt = ConsensusContext(self.preset, self.spec)
+        # merge-transition TTD validation (spec validate_merge_block via
+        # the EL's pow surface): provably wrong terminal blocks are
+        # rejected; unavailable pow data imports optimistically and the
+        # OTB service re-checks on ticks
+        otb_parent_hash = None
+        if self.execution_layer is not None and hasattr(
+            block.body, "execution_payload"
+        ):
+            from ..state_transition.per_block import is_merge_transition_block
+
+            if is_merge_transition_block(state, block.body):
+                parent_hash = bytes(block.body.execution_payload.parent_hash)
+                verdict = self.execution_layer.validate_merge_block(
+                    parent_hash, self.spec
+                )
+                if verdict is False:
+                    raise BlockError(
+                        "merge transition block fails TTD validation"
+                    )
+                if verdict is None:
+                    otb_parent_hash = parent_hash
         if self.execution_layer is not None:
             # engine round trip runs INSIDE process_execution_payload (spec
             # order: after the parent-hash/randao/timestamp checks); the
@@ -342,6 +407,9 @@ class BeaconChain:
         )
         self._states[block_root] = state
         self.early_attester_cache.add(self.preset, block_root, block, state)
+        if otb_parent_hash is not None:
+            self.optimistic_transition_blocks[block_root] = otb_parent_hash
+            self.store.put_chain_item(b"otb:" + block_root, otb_parent_hash)
 
         with M.BLOCK_FORK_CHOICE_TIMES.time():
             self._fork_choice_import(

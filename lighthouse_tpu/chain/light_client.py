@@ -308,7 +308,13 @@ class LightClientStore:
             parsed = [
                 PublicKey.from_bytes(bytes(pk)) for pk in committee.pubkeys
             ]
-            self._parsed_committees = {committee_root: parsed}  # keep 1
+            # cap at 2: current + next is all a store ever holds, and
+            # period-boundary updates alternate between them
+            if len(self._parsed_committees) >= 2:
+                self._parsed_committees.pop(
+                    next(iter(self._parsed_committees))
+                )
+            self._parsed_committees[committee_root] = parsed
         pubkeys = [pk for pk, bit in zip(parsed, bits) if bit]
         # the aggregate signs the attested header root in the slot BEFORE
         # the signature slot (spec get_sync_committee_message domain)

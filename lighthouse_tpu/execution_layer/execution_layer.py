@@ -72,6 +72,39 @@ class ExecutionLayer:
             validator_index, self.suggested_fee_recipient
         )
 
+    def get_pow_block(self, block_hash: bytes):
+        """(parent_hash, total_difficulty) of a pre-merge EL block, or
+        None when the engine does not know it (still syncing) or has no
+        pow surface (reference engines.rs get_pow_block via
+        eth_getBlockByHash)."""
+        getter = getattr(self.engine, "get_pow_block", None)
+        if getter is None:
+            return None
+        return getter(block_hash)
+
+    def validate_merge_block(self, payload_parent_hash: bytes, spec):
+        """Spec validate_merge_block: the transition payload's parent pow
+        block must cross the TTD while ITS parent is still under it.
+        Returns True (valid), False (provably invalid), or None (pow data
+        unavailable: import optimistically, re-check later -- the
+        reference's otb_verification_service seat)."""
+        if any(spec.terminal_block_hash):
+            # terminal-block-hash override networks: the designated block
+            # IS the terminal block; the TTD comparison is skipped
+            return bytes(payload_parent_hash) == bytes(
+                spec.terminal_block_hash
+            )
+        pow_block = self.get_pow_block(payload_parent_hash)
+        if pow_block is None:
+            return None
+        parent_hash, ttd = pow_block
+        if ttd < spec.terminal_total_difficulty:
+            return False
+        pow_parent = self.get_pow_block(parent_hash)
+        if pow_parent is None:
+            return None
+        return pow_parent[1] < spec.terminal_total_difficulty
+
     # -- verification path (block import) -----------------------------------
 
     def notify_new_payload(self, payload) -> PayloadVerificationStatus:

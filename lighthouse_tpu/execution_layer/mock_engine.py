@@ -54,11 +54,27 @@ class MockExecutionEngine(ExecutionEngine):
         self.invalid_hashes: set[bytes] = set()
         self.force_syncing: int = 0
         self.new_payload_log: list[bytes] = []
+        # pow (pre-merge) chain: block_hash -> (parent_hash,
+        # total_difficulty), the eth_getBlockByHash surface merge-block
+        # TTD validation reads (reference engines.rs get_pow_block)
+        self.pow_blocks: dict[bytes, tuple[bytes, int]] = {}
 
     # -- fault injection hooks ----------------------------------------------
 
     def mark_invalid(self, block_hash: bytes) -> None:
         self.invalid_hashes.add(bytes(block_hash))
+
+    def add_pow_block(
+        self, block_hash: bytes, parent_hash: bytes, total_difficulty: int
+    ) -> None:
+        self.pow_blocks[bytes(block_hash)] = (
+            bytes(parent_hash),
+            int(total_difficulty),
+        )
+
+    def get_pow_block(self, block_hash: bytes):
+        """(parent_hash, total_difficulty) or None if unknown."""
+        return self.pow_blocks.get(bytes(block_hash))
 
     # -- engine API ----------------------------------------------------------
 

@@ -149,3 +149,53 @@ class TestInvalidPayloadReorg:
         # later the engine confirms validity
         h.chain.fork_choice.on_valid_execution_payload(root)
         assert not h.chain.is_optimistic(root)
+
+
+class TestMergeTransitionTTD:
+    """Spec validate_merge_block + the OTB re-verification service
+    (reference otb_verification_service.rs): the transition payload's
+    parent pow block must cross the TTD while its own parent stays
+    under it."""
+
+    def _pow_seed(self, engine, h, ttd, parent_td):
+        grandparent = b"\x77" * 32
+        engine.add_pow_block(grandparent, b"\x00" * 32, parent_td)
+        engine.add_pow_block(engine.genesis_hash, grandparent, ttd)
+
+    def test_valid_transition_block_imports_cleanly(self):
+        h, engine = make_harness()
+        ttd = h.spec.terminal_total_difficulty
+        self._pow_seed(engine, h, ttd, ttd - 1)
+        h.extend_chain(2 * MINIMAL.slots_per_epoch + 2)
+        assert h.chain.head_state.fork_name == "bellatrix"
+        # pow data was available and valid: nothing left to re-check
+        assert h.chain.optimistic_transition_blocks == {}
+
+    def test_underpowered_terminal_block_rejected(self):
+        h, engine = make_harness()
+        ttd = h.spec.terminal_total_difficulty
+        # terminal block NEVER reaches the TTD: provably invalid
+        self._pow_seed(engine, h, ttd - 5, ttd - 9)
+        # up to (not including) the transition slot
+        h.extend_chain(2 * MINIMAL.slots_per_epoch - 1)
+        with pytest.raises(Exception, match="TTD"):
+            h.extend_chain(1)  # the transition block
+
+    def test_unknown_pow_data_imports_optimistically_then_invalidates(self):
+        h, engine = make_harness()
+        ttd = h.spec.terminal_total_difficulty
+        # the EL is still syncing at the transition: no pow data AND a
+        # SYNCING newPayload verdict -> a fully optimistic import
+        h.extend_chain(2 * MINIMAL.slots_per_epoch - 1)
+        engine.force_syncing = 2
+        h.extend_chain(2)
+        assert len(h.chain.optimistic_transition_blocks) == 1
+        (otb_root,) = h.chain.optimistic_transition_blocks
+        head_before = h.chain.head_root
+        # the EL syncs and reveals the terminal block was UNDER the TTD
+        self._pow_seed(engine, h, ttd - 5, ttd - 9)
+        h.chain.verify_optimistic_transition_blocks()
+        assert h.chain.optimistic_transition_blocks == {}
+        assert h.chain.fork_choice.is_optimistic(otb_root) is False
+        # the invalidated subtree is no longer the head
+        assert h.chain.head_root != head_before

@@ -725,6 +725,164 @@ def _run_transition_case(case_dir, handler, config, fork) -> CaseResult:
     return CaseResult(case_dir, True)
 
 
+def _mk_container(name: str, fields: dict):
+    """Container class via type(): this module's `from __future__ import
+    annotations` would stringify class-body annotations."""
+    from .ssz import container
+
+    return container(type(name, (), {"__annotations__": dict(fields)}))
+
+
+import functools
+
+
+@functools.lru_cache(maxsize=1)
+def _ssz_generic_test_types():
+    """The spec's ssz_generic test containers (cases/ssz_generic.rs),
+    built once."""
+    from .ssz import (
+        Bitlist,
+        Bitvector,
+        List,
+        Vector,
+        uint8,
+        uint16,
+        uint32,
+        uint64,
+    )
+
+    single = _mk_container("SingleFieldTestStruct", {"A": uint8})
+    small = _mk_container("SmallTestStruct", {"A": uint16, "B": uint16})
+    fixed = _mk_container(
+        "FixedTestStruct", {"A": uint8, "B": uint64, "C": uint32}
+    )
+    var = _mk_container(
+        "VarTestStruct",
+        {"A": uint16, "B": List(uint16, 1024), "C": uint8},
+    )
+    cplx = _mk_container(
+        "ComplexTestStruct",
+        {
+            "A": uint16,
+            "B": List(uint16, 128),
+            "C": uint8,
+            "D": List(uint8, 256),
+            "E": var.ssz_type,
+            "F": Vector(fixed.ssz_type, 4),
+            "G": Vector(var.ssz_type, 2),
+        },
+    )
+    bits = _mk_container(
+        "BitsStruct",
+        {
+            "A": Bitlist(5),
+            "B": Bitvector(2),
+            "C": Bitvector(1),
+            "D": Bitlist(6),
+            "E": Bitvector(8),
+        },
+    )
+    return {
+        "SingleFieldTestStruct": single,
+        "SmallTestStruct": small,
+        "FixedTestStruct": fixed,
+        "VarTestStruct": var,
+        "ComplexTestStruct": cplx,
+        "BitsStruct": bits,
+    }
+
+
+def _ssz_generic_type(handler: str, case: str):
+    """Resolve the SSZ type descriptor a case name encodes, or None if
+    out of surface."""
+    from .ssz import (
+        Bitlist,
+        Bitvector,
+        Vector,
+        boolean,
+        uint8,
+        uint16,
+        uint32,
+        uint64,
+        uint128,
+        uint256,
+    )
+
+    uints = {
+        "8": uint8,
+        "16": uint16,
+        "32": uint32,
+        "64": uint64,
+        "128": uint128,
+        "256": uint256,
+    }
+    elems = {"bool": boolean, **{f"uint{k}": v for k, v in uints.items()}}
+    parts = case.split("_")
+    if handler == "boolean":
+        return boolean
+    if handler == "uints":
+        return uints.get(parts[1])
+    if handler == "basic_vector" and len(parts) >= 3:
+        elem = elems.get(parts[1])
+        try:
+            length = int(parts[2])
+        except ValueError:
+            return None
+        if elem is None or length == 0:
+            return None
+        return Vector(elem, length)
+    if handler == "bitvector" and len(parts) >= 2:
+        try:
+            return Bitvector(int(parts[1]))
+        except ValueError:
+            return None
+    if handler == "bitlist":
+        try:
+            limit = int(parts[1])
+        except (ValueError, IndexError):
+            limit = 2048  # e.g. bitlist_no_delimiter_*: decode must fail
+        return Bitlist(limit)
+    if handler == "containers":
+        cls = _ssz_generic_test_types().get(parts[0])
+        return None if cls is None else cls.ssz_type
+    return None
+
+
+def _run_ssz_generic_case(case_dir, handler, config, fork) -> CaseResult:
+    """ssz_generic/<handler>/{valid,invalid} (cases/ssz_generic.rs):
+    valid cases must round-trip and match the meta root; invalid
+    serializations must FAIL to decode."""
+    suite = os.path.basename(os.path.dirname(case_dir))
+    case = os.path.basename(case_dir)
+    ssz_type = _ssz_generic_type(handler, case)
+    if ssz_type is None:
+        return CaseResult(case_dir, True, "type not in surface (skipped)")
+    raw = _load(case_dir, "serialized.ssz_snappy")
+    if suite == "invalid":
+        try:
+            ssz_type.decode(raw)
+        except Exception:  # noqa: BLE001 -- any decode failure is a pass
+            return CaseResult(case_dir, True)
+        return CaseResult(case_dir, False, "invalid bytes decoded")
+    meta = _load_yaml(case_dir, "meta.yaml") or {}
+    try:
+        value = ssz_type.decode(raw)
+    except Exception as e:  # noqa: BLE001
+        return CaseResult(case_dir, False, f"valid case failed decode: {e}")
+    if ssz_type.encode(value) != raw:
+        return CaseResult(case_dir, False, "re-encode mismatch")
+    want_root = meta.get("root")
+    if want_root is not None:
+        got = ssz_type.hash_tree_root(value)
+        if got != bytes.fromhex(str(want_root).removeprefix("0x")):
+            return CaseResult(case_dir, False, "root mismatch")
+    if handler in ("uints", "boolean"):
+        want_value = _load_yaml(case_dir, "value.yaml")
+        if want_value is not None and int(value) != int(want_value):
+            return CaseResult(case_dir, False, "value mismatch")
+    return CaseResult(case_dir, True)
+
+
 def _run_merkle_proof_case(case_dir, handler, config, fork) -> CaseResult:
     """light_client/single_merkle_proof (cases/merkle_proof_validity.rs):
     the state must PRODUCE the vector's branch for the generalized index,
@@ -791,6 +949,7 @@ _RUNNERS = {
     "light_client": _run_merkle_proof_case,
     "merkle": _run_merkle_proof_case,
     "merkle_proof": _run_merkle_proof_case,
+    "ssz_generic": _run_ssz_generic_case,
 }
 
 

@@ -33,7 +33,17 @@ RETRIES = 8
 # cheap once cached.
 HASH_BUCKETS = (4, 8, 16, 32, 64, 128)
 
-for n_sets in (16, 1024):
+# WARM_SETS=16,1024,4096 to also stage bigger buckets (throughput scales
+# with batch: the final exponentiation is batch-fixed)
+import os  # noqa: E402
+
+SET_SIZES = tuple(
+    int(x)
+    for x in os.environ.get("WARM_SETS", "16,1024").split(",")
+    if x.strip()
+) or (16, 1024)
+
+for n_sets in SET_SIZES:
     t0 = time.perf_counter()
     args = _example_batch(n_sets, 2, distinct=min(32, n_sets), dedup=True)
     print(f"n={n_sets} fixtures {time.perf_counter() - t0:.1f}s", flush=True)

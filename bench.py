@@ -42,11 +42,39 @@ import time
 
 HERE = os.path.dirname(os.path.abspath(__file__))
 TARGET_SETS_PER_S = 10_000 / 0.200  # BASELINE.md north star
+LAST_TPU_PATH = os.path.join(HERE, ".bench_last_tpu.json")
 
 
 def _emit(payload: dict) -> None:
     print(json.dumps(payload))
     sys.stdout.flush()
+
+
+def _load_last_tpu() -> dict | None:
+    """Most recent real-TPU measurement, persisted across runs so a tunnel
+    flap during the driver window still yields a TPU-attributed number
+    (clearly labeled as historical, with its capture time)."""
+    try:
+        with open(LAST_TPU_PATH) as f:
+            obj = json.load(f)
+        return obj if isinstance(obj, dict) else None
+    except (OSError, json.JSONDecodeError):
+        return None
+
+
+def _attach_last_tpu(payload: dict) -> dict:
+    last = _load_last_tpu()
+    if last is not None:
+        payload["last_known_tpu"] = last
+    return payload
+
+
+def _save_last_tpu(result: dict) -> None:
+    try:
+        with open(LAST_TPU_PATH, "w") as f:
+            json.dump(result, f)
+    except OSError:
+        pass
 
 
 def _run_child(mode: str, env_extra: dict, timeout_s: float):
@@ -78,7 +106,7 @@ def _run_child(mode: str, env_extra: dict, timeout_s: float):
 
 
 def orchestrate() -> None:
-    budget = float(os.environ.get("BENCH_BUDGET_S", "520"))
+    budget = float(os.environ.get("BENCH_BUDGET_S", "720"))
     t_start = time.monotonic()
 
     def remaining() -> float:
@@ -86,10 +114,15 @@ def orchestrate() -> None:
 
     errors = []
 
-    # Phase 1: probe backend init with retry/backoff (the tunnel flaps).
+    # Phase 1: probe backend init with retry/backoff (the tunnel flaps on
+    # hours timescales; round 4 lost its TPU artifact to a 170 s probe
+    # window). The probe may now consume everything except a reserved
+    # CPU-fallback slice: a failed probe run has no TPU measurement to
+    # make room for, and the fallback is cache-warm (~90 s).
     platform = None
     probe_timeout = 75.0
-    probe_deadline = min(170.0, budget * 0.40)
+    fallback_reserve = float(os.environ.get("BENCH_FALLBACK_RESERVE_S", "150"))
+    probe_deadline = max(probe_timeout, budget - fallback_reserve)
     attempt = 0
     while remaining() > 30.0:
         elapsed = time.monotonic() - t_start
@@ -108,19 +141,29 @@ def orchestrate() -> None:
         errors.append(f"probe#{attempt}: {err}")
         time.sleep(10.0)
 
-    # Phase 2: measured run on the probed platform.
+    # Phase 2: measured run on the probed platform. A cache-warm TPU child
+    # needs ~120 s minimum; if a late probe success leaves less than that
+    # PLUS the fallback reserve, skip straight to the fallback — starting
+    # a doomed TPU run would eat the reserve and lose the artifact.
     result = None
     if platform and platform != "cpu":
-        ok, result, err = _run_child(
-            "child",
-            {},
-            timeout_s=min(
-                max(120.0, remaining() - 170.0), max(30.0, remaining() - 5.0)
-            ),
-        )
-        if not ok:
-            errors.append(f"tpu-run: {err}")
-            result = None
+        if remaining() < 120.0 + fallback_reserve:
+            errors.append(
+                "tpu-run: skipped (tunnel up late; "
+                f"{int(remaining())}s left < child+fallback budget)"
+            )
+        else:
+            ok, result, err = _run_child(
+                "child",
+                {},
+                timeout_s=min(
+                    max(120.0, remaining() - fallback_reserve),
+                    max(30.0, remaining() - 5.0),
+                ),
+            )
+            if not ok:
+                errors.append(f"tpu-run: {err}")
+                result = None
     elif platform == "cpu":
         # Ambient platform is already CPU: run it directly as the primary
         # measurement, not as a fallback.
@@ -152,17 +195,27 @@ def orchestrate() -> None:
 
     if result is None:
         _emit(
-            {
-                "metric": "bls_signature_sets_verified_per_s_per_chip",
-                "value": 0.0,
-                "unit": "sets/s",
-                "vs_baseline": 0.0,
-                "platform": platform or "none",
-                "error": "; ".join(errors) or "unknown",
-            }
+            _attach_last_tpu(
+                {
+                    "metric": "bls_signature_sets_verified_per_s_per_chip",
+                    "value": 0.0,
+                    "unit": "sets/s",
+                    "vs_baseline": 0.0,
+                    "platform": platform or "none",
+                    "error": "; ".join(errors) or "unknown",
+                }
+            )
         )
         return
 
+    if result.get("platform") == "tpu":
+        # persist for future flapped runs (timestamped: it is historical
+        # context in any artifact it later appears in, not a fresh number)
+        saved = dict(result)
+        saved["measured_at_unix"] = int(time.time())
+        _save_last_tpu(saved)
+    else:
+        _attach_last_tpu(result)
     if errors:
         result["error"] = "; ".join(errors)
     _emit(result)
@@ -253,18 +306,31 @@ def main() -> None:
     elif "--child" in sys.argv:
         child()
     else:
+        # an external SIGTERM (driver timeout) must still yield an artifact:
+        # surface it as an exception so the fallback emit below runs
+        import signal
+
+        def _sigterm(signum, frame):
+            raise RuntimeError("terminated by external signal")
+
+        try:
+            signal.signal(signal.SIGTERM, _sigterm)
+        except (ValueError, OSError):
+            pass
         try:
             orchestrate()
         except BaseException as exc:  # never lose the artifact
             _emit(
-                {
-                    "metric": "bls_signature_sets_verified_per_s_per_chip",
-                    "value": 0.0,
-                    "unit": "sets/s",
-                    "vs_baseline": 0.0,
-                    "platform": "none",
-                    "error": f"orchestrator: {type(exc).__name__}: {exc}",
-                }
+                _attach_last_tpu(
+                    {
+                        "metric": "bls_signature_sets_verified_per_s_per_chip",
+                        "value": 0.0,
+                        "unit": "sets/s",
+                        "vs_baseline": 0.0,
+                        "platform": "none",
+                        "error": f"orchestrator: {type(exc).__name__}: {exc}",
+                    }
+                )
             )
 
 

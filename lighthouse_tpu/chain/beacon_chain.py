@@ -12,7 +12,7 @@ transition -> fork-choice import -> head update.
 from __future__ import annotations
 
 from ..crypto.bls import verify_signature_sets
-from ..fork_choice import ForkChoice
+from ..fork_choice import ForkChoice, ForkChoiceError
 from ..ssz import cached_root
 from ..state_transition import (
     BlockProcessingError,
@@ -26,6 +26,7 @@ from ..types import compute_epoch_at_slot, compute_start_slot_at_epoch
 from ..types.presets import Preset
 from ..store.hot_cold import HotColdDB
 from ..utils.slot_clock import ManualSlotClock
+from ..utils.timeout_lock import TimeoutRLock
 
 
 class BlockError(ValueError):
@@ -96,6 +97,9 @@ class BeaconChain:
         )
         store.put_chain_item(b"head_block_root", genesis_root)
         store.put_chain_item(b"head_state_root", genesis_state_root)
+        # stable anchor for the freezer's chunked block-root fill (slot 0's
+        # "block" is the genesis header, never a stored block)
+        store.put_chain_item(b"genesis_block_root", genesis_root)
         self.head_root = genesis_root
         self.head_state = clone_state(genesis_state)
         # bounded snapshot cache over the store (snapshot_cache.rs seat):
@@ -141,6 +145,12 @@ class BeaconChain:
         # re-verification must survive a restart, or the node permanently
         # follows an invalid payload subtree.
         self.optimistic_transition_blocks: dict[bytes, bytes] = {}
+        self._otb_checked_slot = -1
+        # timeout-guarded chain lock (timeout_rw_lock.rs seat): gossip
+        # workers, the tick loop, and HTTP handlers all mutate chain
+        # state; compound read-modify-write sequences must not
+        # interleave, and a stuck holder raises instead of deadlocking
+        self.lock = TimeoutRLock("beacon_chain")
         from ..store.kv import Column as _Col
 
         for key in self.store.kv.keys(_Col.CHAIN):
@@ -223,14 +233,25 @@ class BeaconChain:
         return self.slot_clock.current_slot()
 
     def on_tick(self) -> None:
-        self.fork_choice.on_tick(self.current_slot)
-        self.verify_optimistic_transition_blocks()
+        with self.lock:
+            self.fork_choice.on_tick(self.current_slot)
+            # throttle OTB re-verification to once per slot
+            # (otb_verification_service.rs polls on epoch intervals)
+            slot = self.current_slot
+            run_otb = slot != self._otb_checked_slot
+            if run_otb:
+                self._otb_checked_slot = slot
+        if run_otb:
+            # engine polling happens OUTSIDE the chain lock: a hung EL
+            # endpoint must delay only OTB checks, not block import
+            self.verify_optimistic_transition_blocks()
 
     def verify_optimistic_transition_blocks(self) -> None:
         """Re-check merge-transition blocks imported while their pow data
         was unavailable (otb_verification_service.rs): once the EL can
         serve the pow chain, a TTD-invalid transition block invalidates
-        its payload subtree in fork choice."""
+        its payload subtree in fork choice. Engine round-trips run
+        unlocked; only the fork-choice mutation takes the chain lock."""
         if self.execution_layer is None:
             return
         from ..store.kv import Column as _Col
@@ -242,7 +263,7 @@ class BeaconChain:
                 # pruned out of fork choice (finalized past, or already
                 # discarded): nothing left to re-verify -- without this,
                 # an engine with no pow surface re-polls forever
-                del self.optimistic_transition_blocks[root]
+                self.optimistic_transition_blocks.pop(root, None)
                 self.store.kv.delete(_Col.CHAIN, b"otb:" + root)
                 continue
             verdict = self.execution_layer.validate_merge_block(
@@ -250,16 +271,18 @@ class BeaconChain:
             )
             if verdict is None:
                 continue  # still no pow data; keep waiting
-            del self.optimistic_transition_blocks[root]
+            self.optimistic_transition_blocks.pop(root, None)
             self.store.kv.delete(_Col.CHAIN, b"otb:" + root)
             if verdict is False:
-                self.fork_choice.on_invalid_execution_payload(root)
+                with self.lock:
+                    self.fork_choice.on_invalid_execution_payload(root)
                 self.recompute_head()
 
     # -- block import (beacon_chain.rs:2520 process_block) ------------------
 
     def state_for_block_production(self, slot: int):
-        state = clone_state(self.head_state)
+        with self.lock:
+            state = clone_state(self.head_state)
         return process_slots(state, slot, self.preset, self.spec)
 
     def process_block(
@@ -276,7 +299,7 @@ class BeaconChain:
 
         from ..utils import metrics as M
 
-        with M.BLOCK_PROCESSING_TIMES.time():
+        with self.lock, M.BLOCK_PROCESSING_TIMES.time():
             try:
                 block_root, fresh = self._process_block_timed(
                     signed_block, strategy, pre_state
@@ -517,16 +540,31 @@ class BeaconChain:
 
     def apply_attestation(self, attestation, indexed_indices) -> None:
         """Feed a verified unaggregated/aggregate attestation into fork
-        choice (verification lives in the processor/verification layer)."""
-        self.fork_choice.on_attestation(
-            attestation.data.slot,
-            indexed_indices,
-            bytes(attestation.data.beacon_block_root),
-        )
+        choice (verification lives in the processor/verification layer).
+
+        Fork choice's spec recency asserts are stricter than gossip
+        admission (gossip accepts anything within ATTESTATION_PROPAGATION_
+        SLOT_RANGE; fork choice wants current/previous epoch only), so a
+        stale-but-gossip-valid attestation is DROPPED here rather than
+        propagated — the reference maps this to a non-fatal error at the
+        same boundary (beacon_chain.rs apply_attestation_to_fork_choice)."""
+        try:
+            with self.lock:
+                self.fork_choice.on_attestation(
+                    attestation.data.slot,
+                    indexed_indices,
+                    bytes(attestation.data.beacon_block_root),
+                )
+        except ForkChoiceError:
+            pass
 
     # -- head (canonical_head.rs recompute_head) ----------------------------
 
     def recompute_head(self) -> bytes:
+        with self.lock:
+            return self._recompute_head_locked()
+
+    def _recompute_head_locked(self) -> bytes:
         head = self.fork_choice.get_head()
         if head != self.head_root:
             self.head_root = head
@@ -604,5 +642,7 @@ class BeaconChain:
                 continue
             if blk.message.slot < fin_slot and root != fin_root:
                 del self._states[root]
-        self.store.migrate_to_freezer(fin_slot, canonical)
+        self.store.migrate_to_freezer(
+            fin_slot, canonical, finalized_state=self._states.get(fin_root)
+        )
         self.fork_choice.proto.proto_array.maybe_prune(fin_root)

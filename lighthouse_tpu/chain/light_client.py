@@ -258,6 +258,14 @@ class LightClientStore:
         self.optimistic_header = bootstrap.header
         self.current_sync_committee = bootstrap.current_sync_committee
         self.next_sync_committee = None
+        # spec get_safety_threshold inputs: rolling max participation over
+        # the current and previous half-periods — the optimistic header
+        # only follows updates with MORE than half the recent max, so a
+        # single captured key cannot steer it
+        self.previous_max_active_participants = 0
+        self.current_max_active_participants = 0
+        self._participation_window = 0
+        self._last_local_window: int | None = None
         # parsed-pubkey cache keyed by committee root: the committee is
         # fixed for a whole sync period (8192 slots on mainnet), so the
         # per-update deserialization of up to 512 keys amortizes to zero
@@ -269,7 +277,62 @@ class LightClientStore:
             * self.preset.epochs_per_sync_committee_period
         )
 
-    def _verify_sync_aggregate(self, update) -> None:
+    def _window_of(self, slot: int) -> int:
+        period_slots = (
+            self.preset.slots_per_epoch
+            * self.preset.epochs_per_sync_committee_period
+        )
+        return (2 * slot) // max(1, period_slots)
+
+    def _rotate_to(self, window: int) -> None:
+        if window == self._participation_window + 1:
+            self.previous_max_active_participants = (
+                self.current_max_active_participants
+            )
+            self.current_max_active_participants = 0
+            self._participation_window = window
+        elif window > self._participation_window + 1:
+            # >=2 windows elapsed with no verified updates: both maxes are
+            # stale — zero them rather than carrying an old high-water mark
+            # into the threshold (it would reject a recovered-but-lower
+            # participation level for an extra half-period)
+            self.previous_max_active_participants = 0
+            self.current_max_active_participants = 0
+            self._participation_window = window
+
+    def process_slot(self, current_slot: int) -> None:
+        """Clock-driven window rotation (spec
+        process_slot_for_light_client_store's UPDATE_TIMEOUT): embedders
+        call this each slot so the safety threshold DECAYS when updates
+        stop arriving — otherwise a stale high-water mark would reject a
+        recovered-but-lower participation level indefinitely."""
+        self._last_local_window = self._window_of(current_slot)
+        self._rotate_to(self._last_local_window)
+
+    def _note_participation(self, n: int, signature_slot: int) -> None:
+        """Track max participation per half-period window. Update-driven
+        rotation is a fallback for undriven stores, capped at the local
+        window when a clock IS driven — a verified-but-future
+        signature_slot must not zero the maxes early (a lone captured key
+        could then steer the threshold to 0)."""
+        window = self._window_of(signature_slot)
+        if self._last_local_window is not None:
+            window = min(window, self._last_local_window)
+        self._rotate_to(window)
+        self.current_max_active_participants = max(
+            self.current_max_active_participants, n
+        )
+
+    def safety_threshold(self) -> int:
+        return (
+            max(
+                self.previous_max_active_participants,
+                self.current_max_active_participants,
+            )
+            // 2
+        )
+
+    def _verify_sync_aggregate(self, update, supermajority: bool = True) -> None:
         from ..crypto.bls import (
             PublicKey,
             Signature,
@@ -282,7 +345,15 @@ class LightClientStore:
 
         bits = list(update.sync_aggregate.sync_committee_bits)
         n = sum(bits)
-        if 3 * n < 2 * len(bits):
+        # Supermajority gates only FINALITY application; optimistic headers
+        # advance above the SAFETY THRESHOLD (spec get_safety_threshold:
+        # strictly more than half the recent max participation) — liveness
+        # at 34-66% participation without following a lone captured key.
+        if supermajority:
+            minimum = -(-2 * len(bits) // 3)
+        else:
+            minimum = max(1, self.safety_threshold() + 1)
+        if n < minimum:
             raise LightClientError(
                 f"insufficient sync participation {n}/{len(bits)}"
             )
@@ -341,6 +412,8 @@ class LightClientStore:
         )
         if not ok:
             raise LightClientError("sync aggregate signature invalid")
+        # only a VERIFIED aggregate may raise the safety-threshold inputs
+        self._note_participation(n, sig_slot)
 
     def process_update(self, update) -> None:
         """Full LightClientUpdate: signature + finality + committee
@@ -390,6 +463,6 @@ class LightClientStore:
     def process_optimistic_update(self, update) -> None:
         """LightClientOptimisticUpdate: signature only; advances the
         optimistic head."""
-        self._verify_sync_aggregate(update)
+        self._verify_sync_aggregate(update, supermajority=False)
         if int(update.attested_header.slot) > int(self.optimistic_header.slot):
             self.optimistic_header = update.attested_header

@@ -225,6 +225,13 @@ def cmd_bn(args):
     # failure broadcasts shutdown and everything joins
     executor = TaskExecutor("bn")
 
+    if hasattr(node, "network"):
+        # event-driven gossip processing (beacon_processor.rs worker pool);
+        # >1 worker lets a slow block import overlap attestation batches
+        node.network.processor.start(
+            num_workers=getattr(args, "processor_workers", 1)
+        )
+
     def notifier():  # client/src/notifier.rs
         head = node.chain.head_state
         log.info("status", slot=node.chain.current_slot, head=head.slot,
@@ -238,9 +245,9 @@ def cmd_bn(args):
                 node.eth1_service.update()
             except Exception as e:  # noqa: BLE001 -- eth1 node flaps
                 log.warn("eth1 update failed", error=str(e))
-        if hasattr(node, "network"):
-            # drain gossip work queued by the wire listener threads
-            # (the BeaconProcessor worker seat, beacon_processor.rs)
+        if hasattr(node, "network") and not node.network.processor.is_running:
+            # no worker pool running (dry-run / embedded use): drain gossip
+            # work inline (the BeaconProcessor worker seat)
             node.network.processor.run_until_idle()
 
     executor.spawn_loop(tick, "per-slot", node.spec.seconds_per_slot)
@@ -572,6 +579,8 @@ def main(argv=None) -> int:
                     help="push process/system/chain health JSON here "
                     "(common/monitoring_api parity)")
     bn.add_argument("--dry-run", action="store_true")
+    bn.add_argument("--processor-workers", type=int, default=1,
+                    help="gossip worker pool size (beacon_processor)")
     bn.set_defaults(fn=cmd_bn)
 
     boot = sub.add_parser("boot-node", help="run a discovery bootnode")

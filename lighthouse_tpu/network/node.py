@@ -165,6 +165,13 @@ class NetworkNode:
         # clock, not the wall clock, so the one-slot maturity window
         # advances with simulated time exactly as with real time.
         from ..processor.reprocess import ReprocessQueue
+        from ..utils.timeout_lock import TimeoutRLock
+
+        # serializes pool/cache mutation across gossip workers (the chain
+        # has its own lock; op/naive/sync pools, observed-* dedup caches,
+        # and the reprocess queue are guarded here). Block import runs
+        # OUTSIDE this lock so a slow import still overlaps batch verify.
+        self.pools_lock = TimeoutRLock("gossip_pools")
 
         sps = chain.spec.seconds_per_slot
         self.reprocess = ReprocessQueue(
@@ -191,7 +198,8 @@ class NetworkNode:
     # -- scoring (peerdb/score.rs) ------------------------------------------
 
     def penalize(self, peer: str, amount: int = GOSSIP_PENALTY) -> None:
-        self.peer_scores[peer] = self.peer_scores.get(peer, 0) + amount
+        with self.pools_lock:
+            self.peer_scores[peer] = self.peer_scores.get(peer, 0) + amount
 
     def is_banned(self, peer: str) -> bool:
         return self.peer_scores.get(peer, 0) <= BAN_THRESHOLD
@@ -273,12 +281,16 @@ class NetworkNode:
         if self.subnet_service is not None:
             self.subnet_service.on_slot(self.chain.current_slot)
         # timed second chance for gossip still waiting on a block
-        for queue, item in self.reprocess.poll():
+        with self.pools_lock:
+            due = list(self.reprocess.poll())
+        for queue, item in due:
             self.processor.submit(queue, item)
 
     def _flush_reprocess(self, block_root: bytes) -> None:
         """A block imported: release gossip that was waiting for it."""
-        for queue, item in self.reprocess.on_block_imported(block_root):
+        with self.pools_lock:
+            released = list(self.reprocess.on_block_imported(block_root))
+        for queue, item in released:
             self.processor.submit(queue, item)
 
     # -- operation gossip (verify_operation.rs + observed_operations.rs) ---
@@ -457,6 +469,10 @@ class NetworkNode:
         self._flush_reprocess(signed_block.message.tree_hash_root())
 
     def _work_aggregates(self, items) -> None:
+        with self.pools_lock:
+            self._work_aggregates_locked(items)
+
+    def _work_aggregates_locked(self, items) -> None:
         aggs = [a for a, _ in items]
         sources = {id(a): s for a, s in items}
         verified, rejected = batch_verify_aggregates(
@@ -484,6 +500,10 @@ class NetworkNode:
                 )
 
     def _work_attestations(self, items) -> None:
+        with self.pools_lock:
+            self._work_attestations_locked(items)
+
+    def _work_attestations_locked(self, items) -> None:
         atts = [a for a, _ in items]
         sources = {id(a): s for a, s in items}
         verified, rejected = batch_verify_unaggregated(
@@ -507,6 +527,10 @@ class NetworkNode:
                 )
 
     def _work_sync_messages(self, items) -> None:
+        with self.pools_lock:
+            self._work_sync_messages_locked(items)
+
+    def _work_sync_messages_locked(self, items) -> None:
         msgs = [(m, subnet) for m, subnet, _ in items]
         sources = {id(m): s for m, _, s in items}
         verified, rejected = batch_verify_sync_messages(
@@ -519,6 +543,10 @@ class NetworkNode:
                 self.penalize(sources.get(id(msg), ""))
 
     def _work_sync_contributions(self, items) -> None:
+        with self.pools_lock:
+            self._work_sync_contributions_locked(items)
+
+    def _work_sync_contributions_locked(self, items) -> None:
         contributions = [c for c, _ in items]
         sources = {id(c): s for c, s in items}
         verified, rejected = batch_verify_contributions(

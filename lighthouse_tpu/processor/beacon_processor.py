@@ -80,10 +80,26 @@ class BeaconProcessor:
         "api_request",
     ]
 
-    def __init__(self, handlers: dict, max_batch: int = 1024):
+    def __init__(
+        self,
+        handlers: dict,
+        max_batch: int = 1024,
+        max_workers: int = 1,
+        journal: bool = False,
+    ):
         """handlers: name -> callable(list_of_items) for batch queues or
-        callable(item) for singleton queues."""
+        callable(item) for singleton queues.
+
+        `max_workers` bounds the worker pool (mod.rs:85-115 max_workers /
+        current_workers accounting): each worker claims the highest-
+        priority available work under the lock and executes its handler
+        outside it, so slow block imports don't stall attestation batch
+        formation. With `journal=True` every claim is recorded as
+        (queue_name, n_items) in dispatch order — the scheduling-order
+        test surface (mod.rs:1052-1061 work journal)."""
         self.max_batch = max_batch
+        self.max_workers = max(1, max_workers)
+        self.journal: list[tuple[str, int]] | None = [] if journal else None
         self.queues = {
             "chain_segment": WorkQueue("chain_segment", 64),
             "gossip_block": WorkQueue("gossip_block", 1024),
@@ -115,59 +131,122 @@ class BeaconProcessor:
         }
         self.handlers = handlers
         self._lock = threading.Lock()
+        self._work_available = threading.Condition(self._lock)
         self._stop = threading.Event()
-        self._thread: threading.Thread | None = None
+        self._threads: list[threading.Thread] = []
+        self._busy_workers = 0
         self.processed = {name: 0 for name in self.queues}
+        self.handler_errors: dict[str, int] = {}
+        self.last_error: str | None = None
 
     def submit(self, queue: str, item) -> bool:
         with self._lock:
-            return self.queues[queue].push(item)
+            ok = self.queues[queue].push(item)
+            if ok:
+                self._work_available.notify()
+            return ok
 
     def _next_work(self):
-        with self._lock:
-            for name in self.PRIORITY:
-                q = self.queues[name]
-                if not len(q):
-                    continue
-                if name in self.batched:
-                    # >=2 queued items repackage into one batch work item
-                    # (mod.rs:1098-1139), capped at the device batch size
-                    return name, q.drain(self.max_batch)
-                return name, [q.pop()]
+        """Claim the highest-priority available work. Must hold the lock."""
+        for name in self.PRIORITY:
+            q = self.queues[name]
+            if not len(q):
+                continue
+            if name in self.batched:
+                # >=2 queued items repackage into one batch work item
+                # (mod.rs:1098-1139), capped at the device batch size
+                items = q.drain(self.max_batch)
+            else:
+                items = [q.pop()]
+            if self.journal is not None:
+                self.journal.append((name, len(items)))
+            return name, items
         return None, None
 
-    def run_until_idle(self) -> int:
-        """Drain all queues in priority order; returns work-item count."""
-        done = 0
-        while True:
-            name, items = self._next_work()
-            if name is None:
-                return done
-            handler = self.handlers.get(name)
+    def _execute(self, name: str, items) -> None:
+        handler = self.handlers.get(name)
+        try:
             if handler is not None:
                 if name in self.batched:
                     handler(items)
                 else:
                     handler(items[0])
+        except Exception as exc:  # noqa: BLE001 -- a poisoned work item
+            # must not kill its worker (mod.rs workers are respawned per
+            # task; here the thread persists, so survive and count)
+            with self._lock:
+                self.handler_errors[name] = (
+                    self.handler_errors.get(name, 0) + 1
+                )
+                self.last_error = f"{name}: {type(exc).__name__}: {exc}"
+        with self._lock:
             self.processed[name] += len(items)
+
+    def run_until_idle(self) -> int:
+        """Drain all queues in priority order on the calling thread;
+        returns work-item count (synchronous mode: tests, simulator)."""
+        done = 0
+        while True:
+            with self._lock:
+                name, items = self._next_work()
+            if name is None:
+                return done
+            self._execute(name, items)
             done += len(items)
 
-    # -- optional background execution --------------------------------------
+    # -- worker pool (mod.rs manager + blocking-task workers) ---------------
 
-    def start(self, poll_interval: float = 0.005) -> None:
-        if self._thread is not None:
+    def start(self, num_workers: int | None = None) -> None:
+        """Spawn the worker pool: each worker blocks on the condition
+        variable, claims the top-priority work, and executes it outside
+        the lock — concurrent handlers up to the pool size."""
+        if self._threads:
             return
+        n = num_workers or self.max_workers
 
-        def loop():
-            while not self._stop.is_set():
-                if self.run_until_idle() == 0:
-                    self._stop.wait(poll_interval)
+        def worker():
+            while True:
+                with self._lock:
+                    name, items = self._next_work()
+                    while name is None:
+                        if self._stop.is_set():
+                            return
+                        self._work_available.wait(0.05)
+                        name, items = self._next_work()
+                    self._busy_workers += 1
+                try:
+                    self._execute(name, items)
+                finally:
+                    with self._lock:
+                        self._busy_workers -= 1
 
-        self._thread = threading.Thread(target=loop, daemon=True)
-        self._thread.start()
+        for _ in range(n):
+            t = threading.Thread(target=worker, daemon=True)
+            t.start()
+            self._threads.append(t)
+
+    @property
+    def is_running(self) -> bool:
+        return bool(self._threads)
+
+    def wait_idle(self, timeout: float = 5.0) -> bool:
+        """Block until every queue is empty and every worker is idle."""
+        import time as _time
+
+        deadline = _time.monotonic() + timeout
+        while _time.monotonic() < deadline:
+            with self._lock:
+                if self._busy_workers == 0 and not any(
+                    len(q) for q in self.queues.values()
+                ):
+                    return True
+            _time.sleep(0.002)
+        return False
 
     def stop(self) -> None:
         self._stop.set()
-        if self._thread is not None:
-            self._thread.join()
-            self._thread = None
+        with self._lock:
+            self._work_available.notify_all()
+        for t in self._threads:
+            t.join()
+        self._threads = []

@@ -154,17 +154,27 @@ def _composite_root(t: SszType, value) -> bytes:
     return t.hash_tree_root(value)
 
 
-_U64_PACK = {}
+_UINT_PACK = {}
+_UINT_FMT = {1: "B", 2: "H", 4: "I", 8: "Q"}
 
 
 def _basic_chunks(elem: SszType, items) -> list[bytes]:
-    """pack_bytes of the encoded items, with a fast path for uint64."""
-    if isinstance(elem, _UInt) and elem.byte_len == 8:
-        n = len(items)
-        fmt = _U64_PACK.get(n)
-        if fmt is None:
-            fmt = _U64_PACK[n] = struct.Struct(f"<{n}Q")
-        data = fmt.pack(*items)
+    """pack_bytes of the encoded items, with struct fast paths for all
+    uint widths (uint8 participation lists and uint64 balances/scores are
+    the 500k-element hot fields; per-element encode() calls dominate the
+    steady-state root otherwise)."""
+    if isinstance(elem, _UInt) and elem.byte_len in _UINT_FMT:
+        if elem.byte_len == 1:
+            data = bytes(items)
+        else:
+            n = len(items)
+            key = (n, elem.byte_len)
+            fmt = _UINT_PACK.get(key)
+            if fmt is None:
+                fmt = _UINT_PACK[key] = struct.Struct(
+                    f"<{n}{_UINT_FMT[elem.byte_len]}"
+                )
+            data = fmt.pack(*items)
     else:
         data = b"".join(elem.encode(v) for v in items)
     return pack_bytes(data)
@@ -269,6 +279,44 @@ def cached_root(obj) -> bytes:
         cache = CachedRoot(desc)
         obj.__dict__["_lh_tree_cache"] = cache
     return cache.root(obj)
+
+
+def surgical_list_update(
+    obj, field_name: str, old_value, new_value, changed_indices
+) -> None:
+    """Install `new_value` into obj.<field_name> and update the instance
+    tree cache leaf-wise: only `changed_indices` get their element roots
+    recomputed (epoch processing touches a handful of 500k validators; a
+    full memo pass per boundary is the dominant steady-state hash cost).
+
+    Sound only when the cache's previous leaf layer corresponds to
+    `old_value` element-for-element and `new_value` differs from it at
+    exactly `changed_indices` (same length). When any precondition fails
+    this degrades to plain assignment — the next cached_root recomputes
+    the field in full, which is always correct."""
+    setattr(obj, field_name, new_value)
+    cache = obj.__dict__.get("_lh_tree_cache")
+    if cache is None:
+        return
+    fc = cache.fields.get(field_name)
+    if (
+        fc is None
+        or fc.tree is None
+        or fc.tree.layers is None
+        or fc.ref is not old_value
+        or len(fc.tree.layers[0]) != len(new_value)
+    ):
+        if fc is not None:
+            fc.ref = None  # force a full field recompute on the next root
+        return
+    t = next(ft for fn, ft in cache.desc.fields if fn == field_name)
+    chunks = list(fc.tree.layers[0])
+    for i in changed_indices:
+        chunks[i] = _composite_root(t.elem, new_value[i])
+    root = fc.tree.update(chunks)
+    if isinstance(t, List):
+        root = mix_in_length(root, len(new_value))
+    fc.ref, fc.root = new_value, root
 
 
 def cached_field_roots(obj) -> list[bytes]:

@@ -85,8 +85,12 @@ def initialize_beacon_state_from_eth1(
     name = spec.fork_name_at_epoch(0)
     if name in ("altair", "bellatrix"):
         state = upgrade_to_altair(state, preset, spec)
+        # genesis.rs:54-67: a fork active AT genesis has no predecessor;
+        # previous_version equals the fork's own version
+        state.fork.previous_version = spec.altair_fork_version
     if name == "bellatrix":
         state = upgrade_to_bellatrix(state, preset, spec)
+        state.fork.previous_version = spec.bellatrix_fork_version
         if execution_payload_header is not None:
             # merge-at-genesis testnets seed the header directly (spec
             # bellatrix initialize_beacon_state_from_eth1 extension)

@@ -40,7 +40,19 @@ BASE_REWARDS_PER_EPOCH = 4
 def process_epoch(state, preset: Preset, spec):
     if state.fork_name == "phase0":
         _process_epoch_base(state, preset, spec)
-    else:
+        return
+    import os
+
+    if os.environ.get("LIGHTHOUSE_TPU_EPOCH_ORACLE"):
+        _process_epoch_altair(state, preset, spec)
+        return
+    from .per_epoch_vec import VectorGuard, process_epoch_altair_vec
+
+    try:
+        process_epoch_altair_vec(state, preset, spec)
+    except VectorGuard:
+        # magnitude guard tripped (pathological state): the arbitrary-
+        # precision loop oracle is always exact
         _process_epoch_altair(state, preset, spec)
 
 

@@ -9,6 +9,7 @@ from __future__ import annotations
 
 import struct
 
+from ..ssz import cached_root as cached_root_of
 from ..state_transition import BlockReplayer, clone_state, process_slots
 from ..types import compute_epoch_at_slot, state_class_for, types_for
 from ..types.presets import Preset
@@ -19,6 +20,58 @@ class StoreError(KeyError):
     pass
 
 
+def latest_block_header_root(state, state_root: bytes) -> bytes:
+    """Root of the last applied block: the state's latest header with its
+    state_root filled when still zeroed (a post-block state's header has
+    it zeroed until the next process_slot; the block's state_root IS that
+    state's root)."""
+    from ..types.containers import BeaconBlockHeader
+
+    hdr = state.latest_block_header
+    return BeaconBlockHeader(
+        slot=hdr.slot,
+        proposer_index=hdr.proposer_index,
+        parent_root=bytes(hdr.parent_root),
+        state_root=(
+            bytes(hdr.state_root)
+            if any(bytes(hdr.state_root))
+            else state_root
+        ),
+        body_root=bytes(hdr.body_root),
+    ).tree_hash_root()
+
+
+CHUNK_SIZE = 128  # roots per freezer chunk row (chunked_vector.rs: 4K pages)
+
+
+class _ChunkWriter:
+    """Buffers chunked-column writes so a migration touches each 4K chunk
+    row once instead of read-modify-writing it per slot."""
+
+    def __init__(self, kv: KeyValueStore):
+        self.kv = kv
+        self.rows: dict[tuple[bytes, int], bytearray] = {}
+
+    def put(self, column: bytes, slot: int, root: bytes) -> None:
+        cindex = slot // CHUNK_SIZE
+        key = (column, cindex)
+        row = self.rows.get(key)
+        if row is None:
+            row = bytearray(
+                self.kv.get(column, struct.pack(">Q", cindex)) or b""
+            )
+            self.rows[key] = row
+        offset = (slot % CHUNK_SIZE) * 32
+        if len(row) < offset + 32:
+            row.extend(bytes(offset + 32 - len(row)))
+        row[offset : offset + 32] = root
+
+    def flush(self) -> None:
+        for (column, cindex), row in self.rows.items():
+            self.kv.put(column, struct.pack(">Q", cindex), bytes(row))
+        self.rows.clear()
+
+
 class HotColdDB:
     def __init__(
         self,
@@ -26,18 +79,35 @@ class HotColdDB:
         preset: Preset,
         spec,
         slots_per_snapshot: int | None = None,
+        slots_per_restore_point: int | None = None,
     ):
         self.kv = kv
         self.preset = preset
         self.spec = spec
         # hot snapshot cadence: every epoch by default
         self.slots_per_snapshot = slots_per_snapshot or preset.slots_per_epoch
-        self.split_slot = 0  # hot/cold boundary (advances on finality)
+        # freezer restore-point cadence (hot_cold_store.rs StoreConfig
+        # slots_per_restore_point): full states in the cold DB at this
+        # interval; states between are rebuilt by replaying <= this many
+        # slots of frozen blocks
+        self.slots_per_restore_point = (
+            slots_per_restore_point or 4 * preset.slots_per_epoch
+        )
         # schema stamp + open-time migrations (metadata.rs,
         # schema_change.rs); refuses newer-schema databases
         from .metadata import ensure_schema
 
         self.schema_migrations_applied = ensure_schema(kv, preset)
+        # hot/cold boundary (advances on finality); restored on reopen so
+        # restarted nodes neither re-freeze nor clobber recorded history
+        stored_split = kv.get(Column.CHAIN, b"split_slot")
+        self.split_slot = (
+            struct.unpack(">Q", stored_split)[0] if stored_split else 0
+        )
+        stored_fill = kv.get(Column.CHAIN, b"state_roots_filled_to")
+        self._state_roots_filled_to = (
+            struct.unpack(">Q", stored_fill)[0] if stored_fill else 0
+        )
 
     # -- blocks --------------------------------------------------------------
 
@@ -76,23 +146,7 @@ class HotColdDB:
             )
             self.kv.put(Column.STATE, state_root, payload)
         else:
-            # block root = header root with state_root filled (the header in
-            # a post-block state still has it zeroed; the block's state_root
-            # IS this state's root)
-            from ..types.containers import BeaconBlockHeader
-
-            hdr = state.latest_block_header
-            block_root = BeaconBlockHeader(
-                slot=hdr.slot,
-                proposer_index=hdr.proposer_index,
-                parent_root=hdr.parent_root,
-                state_root=(
-                    bytes(hdr.state_root)
-                    if any(bytes(hdr.state_root))
-                    else state_root
-                ),
-                body_root=hdr.body_root,
-            ).tree_hash_root()
+            block_root = latest_block_header_root(state, state_root)
             summary = struct.pack(">Q", state.slot) + block_root
             self.kv.put(Column.STATE_SUMMARY, state_root, summary)
         self.kv.put(
@@ -160,12 +214,50 @@ class HotColdDB:
     def get_chain_item(self, key: bytes) -> bytes | None:
         return self.kv.get(Column.CHAIN, key)
 
+    # -- freezer chunked root vectors (store/src/chunked_vector.rs) ---------
+    #
+    # block_roots/state_roots live ONCE in the cold DB as 128-entry chunk
+    # rows keyed by chunk index, instead of duplicated in every frozen
+    # state. vindex == absolute slot; cindex == slot // CHUNK_SIZE.
+
+    def _chunk_put(self, column: bytes, slot: int, root: bytes) -> None:
+        w = _ChunkWriter(self.kv)
+        w.put(column, slot, root)
+        w.flush()
+
+    def _chunk_get(self, column: bytes, slot: int) -> bytes | None:
+        row = self.kv.get(column, struct.pack(">Q", slot // CHUNK_SIZE))
+        if row is None:
+            return None
+        offset = (slot % CHUNK_SIZE) * 32
+        if len(row) < offset + 32:
+            return None
+        root = bytes(row[offset : offset + 32])
+        return root if any(root) else None
+
+    def cold_block_root_at_slot(self, slot: int) -> bytes | None:
+        return self._chunk_get(Column.FREEZER_BLOCK_ROOTS, slot)
+
+    def cold_state_root_at_slot(self, slot: int) -> bytes | None:
+        return self._chunk_get(Column.FREEZER_STATE_ROOTS, slot)
+
     # -- freezer migration (hot_cold_store.rs:48-53 + migrate.rs) -----------
 
-    def migrate_to_freezer(self, finalized_slot: int, canonical_roots) -> None:
+    def migrate_to_freezer(
+        self, finalized_slot: int, canonical_roots, finalized_state=None
+    ) -> None:
         """Move finalized blocks to the freezer column and advance the
         split point; prune non-canonical hot entries older than the split.
-        `canonical_roots`: {block_root} on the finalized chain."""
+        `canonical_roots`: {block_root} on the finalized chain.
+
+        With `finalized_state` (the finalized block's post-state) the
+        freezer also records the migrated range's per-slot block/state
+        roots into the chunked columns and stores restore-point states at
+        slots_per_restore_point cadence — historical loads then cost at
+        most one restore-point read + a bounded block replay
+        (hot_cold_store.rs store_cold_state/load_cold_state)."""
+        old_split = self.split_slot
+        migrated = []  # canonical (slot, root) for per-slot root derivation
         for root in list(self.kv.keys(Column.BLOCK)):
             data = self.kv.get(Column.BLOCK, root)
             if data is None:
@@ -174,9 +266,200 @@ class HotColdDB:
             if block.message.slot < finalized_slot:
                 if root in canonical_roots:
                     self.kv.put(Column.FREEZER_BLOCK, root, data)
+                    migrated.append((int(block.message.slot), bytes(root)))
                 self.kv.delete(Column.BLOCK, root)
+        self._freeze_block_roots(old_split, finalized_slot, migrated)
+        if finalized_state is not None:
+            self._freeze_state_roots(finalized_slot, finalized_state)
+        self._store_restore_points(old_split, finalized_slot)
         self.split_slot = finalized_slot
         self.put_chain_item(b"split_slot", struct.pack(">Q", finalized_slot))
+
+    def _freeze_block_roots(
+        self, old_split: int, finalized_slot: int, migrated
+    ) -> None:
+        """Per-slot block roots for [old_split, finalized_slot) from the
+        migrated canonical blocks themselves (ring semantics: an empty slot
+        repeats the previous block's root) — coverage never depends on any
+        state's ring buffer, so long non-finality cannot punch holes."""
+        writer = _ChunkWriter(self.kv)
+        migrated.sort()
+        prev = self.cold_block_root_at_slot(old_split - 1) if old_split else None
+        for slot in range(old_split, finalized_slot):
+            while migrated and migrated[0][0] <= slot:
+                prev = migrated.pop(0)[1]
+            if prev is None:
+                # before the first canonical block: slot 0's "block" is the
+                # genesis header, recorded at chain init. Databases that
+                # predate that item fall back to the backfill anchor (for
+                # genesis-start chains it IS the genesis root; checkpoint
+                # chains have no served history below the anchor anyway).
+                prev = self.get_chain_item(
+                    b"genesis_block_root"
+                ) or self.get_chain_item(b"oldest_block_root")
+                if prev is None:
+                    continue
+            writer.put(Column.FREEZER_BLOCK_ROOTS, slot, prev)
+        writer.flush()
+
+    def _freeze_state_roots(self, finalized_slot: int, finalized_state) -> None:
+        """State roots from the finalized state's ring, tracked by a
+        persisted low-water mark: a finalized epoch that starts with empty
+        slots leaves the tail unmaterialized this round, and the NEXT
+        migration backfills it from a later ring (those state roots exist
+        in any state that advanced past the gap).
+
+        If finality ever jumps by more than the ring (non-finality longer
+        than slots_per_historical_root), the stretch the ring cannot cover
+        is patched from the canonical frozen blocks themselves: a block's
+        state_root IS the state root at its slot. Only empty slots inside
+        such a stretch stay unrecorded (their states were never part of
+        any block), and the state-roots iterator raises for them."""
+        writer = _ChunkWriter(self.kv)
+        ring = self.preset.slots_per_historical_root
+        covered = min(finalized_slot, int(finalized_state.slot))
+        lo = max(self._state_roots_filled_to, covered - ring)
+        for slot in range(self._state_roots_filled_to, lo):
+            root = self.cold_block_root_at_slot(slot)
+            if root is None:
+                continue
+            if slot and root == self.cold_block_root_at_slot(slot - 1):
+                continue  # empty slot: no block-anchored state root
+            block = self.get_block_any_temperature(root)
+            if block is not None and int(block.message.slot) == slot:
+                writer.put(
+                    Column.FREEZER_STATE_ROOTS,
+                    slot,
+                    bytes(block.message.state_root),
+                )
+        for slot in range(lo, covered):
+            writer.put(
+                Column.FREEZER_STATE_ROOTS,
+                slot,
+                bytes(finalized_state.state_roots[slot % ring]),
+            )
+        writer.flush()
+        if covered > self._state_roots_filled_to:
+            self._state_roots_filled_to = covered
+            self.put_chain_item(
+                b"state_roots_filled_to", struct.pack(">Q", covered)
+            )
+
+    def _store_restore_points(self, old_split: int, finalized_slot: int) -> None:
+        """Full states at restore-point cadence, loaded strictly by the
+        AUTHORITATIVE root from the chunked column — never by the
+        last-writer-wins state_at_slot index, which can name a
+        non-canonical fork's state.
+
+        The scan starts at the earliest restore-point slot that is still
+        missing (not at old_split): a slot skipped last round because its
+        state root was in an empty-slot gap is retried once the next
+        migration's ring backfill records the root."""
+        spr = self.slots_per_restore_point
+        start = 0
+        stored = self.get_chain_item(b"restore_points_to")
+        if stored is not None:
+            start = struct.unpack(">Q", stored)[0]
+        all_present = True
+        for slot in range(start + (-start % spr), finalized_slot, spr):
+            if self.kv.get(Column.FREEZER_STATE, slot_key(slot)) is not None:
+                continue
+            state_root = self.cold_state_root_at_slot(slot)
+            if state_root is None:
+                all_present = False
+                continue
+            try:
+                state = self.get_state(state_root)
+            except StoreError:
+                all_present = False
+                continue
+            payload = (
+                b"F" + state.fork_name.encode() + b"\x00" + state.as_ssz_bytes()
+            )
+            self.kv.put(Column.FREEZER_STATE, slot_key(slot), payload)
+        if all_present:
+            self.put_chain_item(
+                b"restore_points_to", struct.pack(">Q", finalized_slot)
+            )
+
+    def load_cold_state(self, slot: int):
+        """Historical (pre-split) state at `slot`: nearest restore point at
+        or below, then replay the frozen canonical blocks up to `slot`
+        (bounded by slots_per_restore_point; reference
+        hot_cold_store.rs load_cold_state_by_slot + reconstruct.rs)."""
+        spr = self.slots_per_restore_point
+        rp_slot = slot - slot % spr
+        base = None
+        while rp_slot >= 0:
+            data = self.kv.get(Column.FREEZER_STATE, slot_key(rp_slot))
+            if data is not None:
+                fork, _, body = data[1:].partition(b"\x00")
+                t = types_for(self.preset)
+                base = state_class_for(t, fork.decode()).from_ssz_bytes(body)
+                break
+            rp_slot -= spr
+        if base is None:
+            raise StoreError(f"no restore point at or below slot {slot}")
+        # canonical blocks in (rp_slot, slot]: consecutive equal roots in
+        # the chunked vector mean empty slots. A missing root is a REAL
+        # error — silently skipping would replay a wrong chain.
+        chain = []
+        prev = self.cold_block_root_at_slot(rp_slot)
+        if prev is None:
+            raise StoreError(f"no frozen block root at restore slot {rp_slot}")
+        for s in range(rp_slot + 1, slot + 1):
+            r = self.cold_block_root_at_slot(s)
+            if r is None:
+                raise StoreError(f"no frozen block root at slot {s}")
+            if r == prev:
+                continue
+            block = self.get_block_any_temperature(r)
+            if block is None:
+                raise StoreError(f"missing frozen block {r.hex()[:12]}")
+            chain.append(block)
+            prev = r
+        replayer = BlockReplayer(base, self.preset, self.spec)
+        replayer.apply_blocks(chain, target_slot=slot)
+        return replayer.state
+
+    # -- forward iterators (store/src/forwards_iter.rs) ---------------------
+
+    def forwards_block_roots_iter(self, start_slot: int, end_slot: int, state):
+        """Yield (block_root, slot) ascending over [start_slot, end_slot].
+        The frozen range reads the chunked vector (FrozenForwardsIterator);
+        the hot range reads `state`'s ring buffer (SimpleForwardsIterator —
+        `state` must cover it, i.e. end_slot within slots_per_historical_root
+        of state.slot)."""
+        yield from self._forwards_iter(
+            start_slot, end_slot, state, Column.FREEZER_BLOCK_ROOTS, "block_roots"
+        )
+
+    def forwards_state_roots_iter(self, start_slot: int, end_slot: int, state):
+        yield from self._forwards_iter(
+            start_slot, end_slot, state, Column.FREEZER_STATE_ROOTS, "state_roots"
+        )
+
+    def _forwards_iter(self, start_slot, end_slot, state, column, field):
+        ring = self.preset.slots_per_historical_root
+        for slot in range(start_slot, end_slot + 1):
+            if slot < self.split_slot:
+                root = self._chunk_get(column, slot)
+                if root is None:
+                    raise StoreError(f"no frozen {field} for slot {slot}")
+            elif slot == state.slot:
+                # the state's own slot is not in its ring buffers yet; the
+                # reference computes these on demand (forwards_iter.rs)
+                if field == "state_roots":
+                    root = cached_root_of(state)
+                else:
+                    root = latest_block_header_root(
+                        state, cached_root_of(state)
+                    )
+            elif not (state.slot - ring <= slot < state.slot):
+                raise StoreError(f"slot {slot} outside hot ring")
+            else:
+                root = bytes(getattr(state, field)[slot % ring])
+            yield root, slot
 
     def get_block_any_temperature(self, block_root: bytes):
         blk = self.get_block(block_root)
@@ -191,9 +474,14 @@ class HotColdDB:
         """Replace stored full bellatrix blocks with their BLINDED form
         (payload -> header; block roots are identical by SSZ design), like
         `lighthouse db prune-payloads` (database_manager/src/lib.rs).
-        Returns the number of pruned blocks."""
+        Returns the number of pruned blocks. With no explicit boundary the
+        prune stops at the hot/cold split (finalized) slot — the reference
+        prunes only finalized payloads, never the head's, so the node can
+        still serve full blocks over req/resp and re-notify the EL."""
         from ..state_transition.per_block import payload_to_header
 
+        if before_slot is None:
+            before_slot = self.split_slot
         t = types_for(self.preset)
         pruned = 0
         for col in (Column.BLOCK, Column.FREEZER_BLOCK):

@@ -20,6 +20,11 @@ class Column:
     CHAIN = b"chn"
     FREEZER_BLOCK = b"fbk"
     FREEZER_STATE = b"fst"
+    # chunked per-slot root vectors (reference store/src/chunked_vector.rs:
+    # block_roots/state_roots stored once globally in 128-entry chunk rows
+    # instead of duplicated inside every frozen state)
+    FREEZER_BLOCK_ROOTS = b"fbr"
+    FREEZER_STATE_ROOTS = b"fsr"
 
 
 class KeyValueStore:

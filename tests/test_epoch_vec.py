@@ -1,0 +1,149 @@
+"""Differential test: the vectorized altair epoch processor
+(state_transition/per_epoch_vec.py) must be bit-exact against the
+pure-Python spec oracle (per_epoch._process_epoch_altair) — compared by
+full post-state tree hash over states that exercise rewards, penalties,
+leaks, ejections, activations, slashings and hysteresis crossings.
+"""
+
+from __future__ import annotations
+
+import random
+
+import pytest
+
+from lighthouse_tpu.state_transition import clone_state
+from lighthouse_tpu.state_transition.per_epoch import _process_epoch_altair
+from lighthouse_tpu.state_transition.per_epoch_vec import (
+    VectorGuard,
+    process_epoch_altair_vec,
+)
+from lighthouse_tpu.types import FAR_FUTURE_EPOCH
+from lighthouse_tpu.types.presets import MINIMAL
+
+
+def _scramble(state, seed: int, *, leak: bool, spec) -> None:
+    """Push a healthy harness state into the interesting corners."""
+    rng = random.Random(seed)
+    n = len(state.validators)
+    vals = list(state.validators)
+    balances = list(state.balances)
+    for i in range(n):
+        r = rng.random()
+        if r < 0.08:
+            vals[i].slashed = True
+            vals[i].withdrawable_epoch = rng.choice(
+                [
+                    # exact half-vector hit: slashing penalty applies
+                    (state.slot // MINIMAL.slots_per_epoch)
+                    + MINIMAL.epochs_per_slashings_vector // 2,
+                    state.slot // MINIMAL.slots_per_epoch + 3,
+                ]
+            )
+        elif r < 0.14:
+            # ejection candidate
+            vals[i].effective_balance = spec.ejection_balance
+            balances[i] = spec.ejection_balance
+        elif r < 0.20:
+            # pending, never activated: activation-queue candidate
+            vals[i].activation_epoch = FAR_FUTURE_EPOCH
+            vals[i].activation_eligibility_epoch = rng.choice(
+                [FAR_FUTURE_EPOCH, 0, 1]
+            )
+        elif r < 0.30:
+            # hysteresis crossing: balance far from effective balance
+            balances[i] = rng.choice(
+                [
+                    balances[i] + 3 * spec.effective_balance_increment,
+                    max(0, balances[i] - 2 * spec.effective_balance_increment),
+                ]
+            )
+    state.validators = tuple(vals)
+    state.balances = tuple(balances)
+    state.inactivity_scores = tuple(
+        rng.choice([0, 1, 4, 17, 1000]) for _ in range(n)
+    )
+    # randomize participation bitfields (keep some fully-participating)
+    state.previous_epoch_participation = tuple(
+        rng.choice([0, 1, 3, 7, 7, 7]) for _ in range(n)
+    )
+    state.current_epoch_participation = tuple(
+        rng.choice([0, 1, 3, 7]) for _ in range(n)
+    )
+    slashings = list(state.slashings)
+    slashings[0] = 64 * 10**9
+    state.slashings = tuple(slashings)
+    if leak:
+        from lighthouse_tpu.types.containers import Checkpoint
+
+        state.finalized_checkpoint = Checkpoint(epoch=0, root=bytes(32))
+        state.previous_justified_checkpoint = Checkpoint(
+            epoch=0, root=bytes(32)
+        )
+
+
+def _altair_state(n_epochs: int):
+    from lighthouse_tpu.harness import BeaconChainHarness
+    from lighthouse_tpu.types import ChainSpec
+
+    spec = ChainSpec.interop(altair_fork_epoch=0)
+    h = BeaconChainHarness(32, MINIMAL, spec, sign=False)
+    h.extend_chain(n_epochs * MINIMAL.slots_per_epoch - 1)
+    return h.chain.head_state, spec
+
+
+@pytest.mark.parametrize("seed,leak", [(1, False), (2, True), (3, False)])
+def test_vec_matches_oracle(seed, leak):
+    state, spec = _altair_state(3)
+    _scramble(state, seed, leak=leak, spec=spec)
+    a = clone_state(state)
+    b = clone_state(state)
+    _process_epoch_altair(a, MINIMAL, spec)
+    process_epoch_altair_vec(b, MINIMAL, spec)
+    assert a.tree_hash_root() == b.tree_hash_root()
+
+
+@pytest.mark.parametrize("seed,leak", [(4, False), (5, True)])
+def test_vec_keeps_incremental_hash_cache_consistent(seed, leak):
+    """The surgical tree-cache writeback (ssz.cached.surgical_list_update)
+    must leave cached_root equal to a from-scratch merkleization across
+    epoch boundaries that eject, activate, and hysteresis-adjust."""
+    from lighthouse_tpu.ssz import cached_root
+    from lighthouse_tpu.state_transition import process_slots
+
+    state, spec = _altair_state(3)
+    _scramble(state, seed, leak=leak, spec=spec)
+    cached_root(state)  # build the incremental cache pre-boundary
+    state = process_slots(state, state.slot + 2, MINIMAL, spec)
+    assert cached_root(state) == clone_state(state).tree_hash_root()
+    # a second boundary rides the epoch-column cache (identity hit path)
+    state = process_slots(
+        state, state.slot + MINIMAL.slots_per_epoch, MINIMAL, spec
+    )
+    assert cached_root(state) == clone_state(state).tree_hash_root()
+
+
+def test_vec_guard_falls_back_cleanly():
+    """A pathological inactivity score trips the guard BEFORE any state
+    mutation, so process_epoch's oracle fallback sees the pristine state."""
+    state, spec = _altair_state(3)
+    scores = list(state.inactivity_scores)
+    scores[0] = 2**60
+    state.inactivity_scores = tuple(scores)
+    pristine_root = state.tree_hash_root()
+    with pytest.raises(VectorGuard):
+        process_epoch_altair_vec(clone_state(state), MINIMAL, spec)
+    # guard must not have mutated anything observable
+    probe = clone_state(state)
+    try:
+        process_epoch_altair_vec(probe, MINIMAL, spec)
+    except VectorGuard:
+        pass
+    assert probe.tree_hash_root() == pristine_root
+
+    from lighthouse_tpu.state_transition.per_epoch import process_epoch
+
+    a = clone_state(state)
+    b = clone_state(state)
+    _process_epoch_altair(a, MINIMAL, spec)
+    process_epoch(b, MINIMAL, spec)  # routes through guard -> oracle
+    assert a.tree_hash_root() == b.tree_hash_root()

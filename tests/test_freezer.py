@@ -1,0 +1,166 @@
+"""Freezer depth tests (VERDICT r4 item 7): chunked block/state-root
+columns, restore points, bounded-replay cold state loads, and forward
+iterators — semantics mirroring reference store/src/chunked_vector.rs,
+hot_cold_store.rs store/load_cold_state, forwards_iter.rs.
+"""
+
+from __future__ import annotations
+
+import pytest
+
+from lighthouse_tpu.store.hot_cold import CHUNK_SIZE, StoreError
+from lighthouse_tpu.types.presets import MINIMAL
+
+
+@pytest.fixture(scope="module")
+def finalized_harness():
+    """A chain long enough to finalize and migrate several epochs."""
+    from lighthouse_tpu.harness import BeaconChainHarness
+
+    h = BeaconChainHarness(16, MINIMAL, sign=False)
+    # restore point every epoch so the migrated range holds several
+    h.store.slots_per_restore_point = MINIMAL.slots_per_epoch
+    h.extend_chain(6 * MINIMAL.slots_per_epoch, attest=True)
+    assert h.chain.fork_choice.finalized_checkpoint[0] >= 3
+    return h
+
+
+def test_migration_records_chunked_roots(finalized_harness):
+    h = finalized_harness
+    split = h.store.split_slot
+    assert split >= 3 * MINIMAL.slots_per_epoch
+    state = h.chain.head_state
+    ring = MINIMAL.slots_per_historical_root
+    for slot in range(1, split):
+        got = h.store.cold_block_root_at_slot(slot)
+        assert got is not None, f"missing frozen block root at slot {slot}"
+        # cross-check against the head state's ring where it still covers
+        if state.slot - ring <= slot < state.slot:
+            assert got == bytes(state.block_roots[slot % ring])
+        sr = h.store.cold_state_root_at_slot(slot)
+        assert sr is not None
+        if state.slot - ring <= slot < state.slot:
+            assert sr == bytes(state.state_roots[slot % ring])
+
+
+def test_restore_points_stored_at_cadence(finalized_harness):
+    h = finalized_harness
+    from lighthouse_tpu.store.kv import Column, slot_key
+
+    spr = h.store.slots_per_restore_point
+    stored = [
+        slot
+        for slot in range(0, h.store.split_slot, spr)
+        if h.store.kv.get(Column.FREEZER_STATE, slot_key(slot)) is not None
+    ]
+    assert len(stored) >= 2, f"expected restore points, got {stored}"
+
+
+def test_load_cold_state_bounded_replay(finalized_harness):
+    h = finalized_harness
+    spr = h.store.slots_per_restore_point
+    # a mid-interval slot: restore point + replay of < spr slots
+    target = spr + spr // 2
+    assert target < h.store.split_slot
+    state = h.store.load_cold_state(target)
+    assert state.slot == target
+    # the reconstructed state's root must match the recorded chunked root
+    assert (
+        state.tree_hash_root() == h.store.cold_state_root_at_slot(target)
+    )
+
+
+def test_load_cold_state_at_restore_point(finalized_harness):
+    h = finalized_harness
+    spr = h.store.slots_per_restore_point
+    state = h.store.load_cold_state(spr)
+    assert state.slot == spr
+    assert state.tree_hash_root() == h.store.cold_state_root_at_slot(spr)
+
+
+def test_forwards_block_roots_iter_spans_split(finalized_harness):
+    """One iteration crossing the frozen/hot boundary, matching the
+    semantics of forwards_iter.rs (chunked source below the split, state
+    ring above)."""
+    h = finalized_harness
+    state = h.chain.head_state
+    split = h.store.split_slot
+    start = max(1, split - 4)
+    end = min(int(state.slot) - 1, split + 3)
+    got = dict(
+        (slot, root)
+        for root, slot in h.store.forwards_block_roots_iter(start, end, state)
+    )
+    assert sorted(got) == list(range(start, end + 1))
+    ring = MINIMAL.slots_per_historical_root
+    for slot in range(start, end + 1):
+        assert got[slot] == bytes(state.block_roots[slot % ring])
+
+
+def test_forwards_block_roots_iter_at_head_slot(finalized_harness):
+    """The state's own slot is not in its ring yet: the iterator must
+    derive the head block root from the latest header, not yield the
+    stale/zero ring entry (review-confirmed bug)."""
+    h = finalized_harness
+    state = h.chain.head_state
+    end = int(state.slot)
+    pairs = list(h.store.forwards_block_roots_iter(end, end, state))
+    assert pairs == [(h.chain.head_root, end)]
+
+
+def test_forwards_state_roots_iter_includes_own_slot(finalized_harness):
+    h = finalized_harness
+    state = h.chain.head_state
+    end = int(state.slot)
+    pairs = list(
+        h.store.forwards_state_roots_iter(end - 2, end, state)
+    )
+    assert [s for _, s in pairs] == [end - 2, end - 1, end]
+    # the final entry is the state's own root, computed on demand
+    assert pairs[-1][0] == state.tree_hash_root()
+
+
+def test_forwards_iter_raises_outside_coverage(finalized_harness):
+    h = finalized_harness
+    state = h.chain.head_state
+    with pytest.raises(StoreError):
+        list(
+            h.store.forwards_block_roots_iter(
+                h.store.split_slot, int(state.slot) + 100, state
+            )
+        )
+
+
+def test_reopen_restores_split_and_preserves_chunks(finalized_harness):
+    """A reopened HotColdDB must restore split_slot from the CHAIN column
+    (review-confirmed bug: a fresh open at split 0 re-migrated from
+    genesis and overwrote recorded chunk rows with the genesis root)."""
+    from lighthouse_tpu.store.hot_cold import HotColdDB
+
+    h = finalized_harness
+    reopened = HotColdDB(h.store.kv, MINIMAL, h.chain.spec)
+    assert reopened.split_slot == h.store.split_slot
+    assert reopened._state_roots_filled_to == h.store._state_roots_filled_to
+    for slot in range(1, reopened.split_slot):
+        assert reopened.cold_block_root_at_slot(
+            slot
+        ) == h.store.cold_block_root_at_slot(slot)
+
+
+def test_chunk_rows_are_dense():
+    """Chunk row layout: CHUNK_SIZE roots per row, read-modify-write."""
+    from lighthouse_tpu.store.hot_cold import HotColdDB
+    from lighthouse_tpu.store.kv import Column, MemoryStore
+    from lighthouse_tpu.types import ChainSpec
+
+    db = HotColdDB(MemoryStore(), MINIMAL, ChainSpec.interop())
+    import struct as _s
+
+    r1, r2 = b"\x11" * 32, b"\x22" * 32
+    db._chunk_put(Column.FREEZER_BLOCK_ROOTS, 5, r1)
+    db._chunk_put(Column.FREEZER_BLOCK_ROOTS, CHUNK_SIZE + 1, r2)
+    assert db.cold_block_root_at_slot(5) == r1
+    assert db.cold_block_root_at_slot(CHUNK_SIZE + 1) == r2
+    assert db.cold_block_root_at_slot(6) is None
+    rows = db.kv.keys(Column.FREEZER_BLOCK_ROOTS)
+    assert sorted(rows) == [_s.pack(">Q", 0), _s.pack(">Q", 1)]

@@ -189,3 +189,28 @@ def test_cli_deposit_contract_genesis_over_real_rpc():
         assert is_valid_genesis_state(chain.head_state, MINIMAL, spec)
     finally:
         server.stop()
+
+
+def test_initialize_at_altair_sets_own_previous_version():
+    """A fork active AT genesis has no predecessor: previous_version equals
+    the fork's own version (reference genesis.rs:54-67); without this the
+    state root diverges from the official altair genesis vectors."""
+    from lighthouse_tpu.types import ChainSpec
+
+    spec = ChainSpec.interop(altair_fork_epoch=0)
+    datas = [_deposit_data(i) for i in range(4)]
+    state = initialize_beacon_state_from_eth1(
+        b"\x11" * 32, 10, _deposits(datas), MINIMAL, spec
+    )
+    assert state.fork_name == "altair"
+    assert bytes(state.fork.previous_version) == bytes(spec.altair_fork_version)
+    assert bytes(state.fork.current_version) == bytes(spec.altair_fork_version)
+
+    spec2 = ChainSpec.interop(altair_fork_epoch=0, bellatrix_fork_epoch=0)
+    state2 = initialize_beacon_state_from_eth1(
+        b"\x11" * 32, 10, _deposits(datas), MINIMAL, spec2
+    )
+    assert state2.fork_name == "bellatrix"
+    assert bytes(state2.fork.previous_version) == bytes(
+        spec2.bellatrix_fork_version
+    )

@@ -333,5 +333,69 @@ class TestLightClientStore:
             )
             with pytest.raises(LightClientError):
                 store.process_finality_update(u_thin)
+
+            # --- optimistic path: safety threshold, not supermajority ---
+            # (spec get_safety_threshold: the optimistic header follows
+            # any VERIFIED aggregate with MORE than half the recent max
+            # participation; a lone captured key cannot steer it)
+            from lighthouse_tpu.chain.light_client import (
+                light_client_optimistic_update,
+            )
+            from lighthouse_tpu.state_transition import (
+                clone_state,
+                process_slots,
+            )
+
+            committee_pks = list(state.current_sync_committee.pubkeys)
+            n_committee = len(committee_pks)
+            assert store.current_max_active_participants == n_committee
+
+            adv = process_slots(
+                clone_state(state), int(state.slot) + 1, MINIMAL, h.spec
+            )
+            opt_sig_slot = int(adv.slot) + 1
+
+            def _signed_optimistic(attested, n_bits, slot):
+                ep = compute_epoch_at_slot(slot - 1, MINIMAL)
+                dom = compute_domain(
+                    DOMAIN_SYNC_COMMITTEE,
+                    h.spec.fork_version_at_epoch(ep),
+                    bytes(state.genesis_validators_root),
+                )
+                u_ = light_client_optimistic_update(
+                    attested, _empty_agg(), slot, MINIMAL
+                )
+                r = SigningData(
+                    object_root=u_.attested_header.tree_hash_root(),
+                    domain=dom,
+                ).tree_hash_root()
+                bits = [i < n_bits for i in range(n_committee)]
+                part_sigs = [
+                    sk_by_pk[bytes(pk)].sign(r)
+                    for pk, b in zip(committee_pks, bits)
+                    if b
+                ]
+                u_.sync_aggregate = types_for(MINIMAL).SyncAggregate(
+                    sync_committee_bits=bits,
+                    sync_committee_signature=AggregateSignature.aggregate(
+                        part_sigs
+                    ).to_bytes(),
+                )
+                return u_
+
+            # sub-supermajority but above threshold (liveness at ~53%)
+            ok_u = _signed_optimistic(
+                adv, n_committee // 2 + 1, opt_sig_slot
+            )
+            store.process_optimistic_update(ok_u)
+            assert (
+                store.optimistic_header.tree_hash_root()
+                == ok_u.attested_header.tree_hash_root()
+            )
+
+            # a single participant is below the safety threshold
+            lone = _signed_optimistic(adv, 1, opt_sig_slot)
+            with pytest.raises(LightClientError):
+                store.process_optimistic_update(lone)
         finally:
             set_backend("fake")

@@ -210,3 +210,140 @@ class TestBeaconProcessor:
         assert q.dropped == 2
         # newest survive (LIFO sheds oldest)
         assert sorted(q.items) == [2, 3, 4, 5]
+
+
+class TestBeaconProcessorWorkerPool:
+    def test_work_journal_orders_mixed_load_across_workers(self):
+        """mod.rs:1052-1061 work-journal analogue: with the pool BLOCKED on
+        a slow item, a burst of mixed work lands in the queues; on release
+        the claim journal must follow the priority dispatch chain (blocks,
+        aggregates-as-one-batch, attestation batches, sync messages, then
+        api requests), regardless of submission order."""
+        import threading
+
+        gate = threading.Event()
+        bp = BeaconProcessor(
+            handlers={
+                "gossip_block": lambda b: gate.wait(5.0),
+                "gossip_aggregate": lambda xs: None,
+                "gossip_attestation": lambda xs: None,
+                "gossip_sync_message": lambda xs: None,
+                "api_request": lambda x: None,
+            },
+            max_batch=64,
+            max_workers=2,
+            journal=True,
+        )
+        bp.start()
+        try:
+            # occupy BOTH workers with gated blocks
+            bp.submit("gossip_block", "B0")
+            bp.submit("gossip_block", "B1")
+            deadline = threading.Event()
+            for _ in range(50):
+                with bp._lock:
+                    busy = bp._busy_workers
+                if busy == 2:
+                    break
+                deadline.wait(0.01)
+            assert busy == 2
+            # mixed burst in deliberately inverted priority order
+            bp.submit("api_request", "R")
+            for i in range(5):
+                bp.submit("gossip_sync_message", f"s{i}")
+            for i in range(100):
+                bp.submit("gossip_attestation", f"a{i}")
+            for i in range(3):
+                bp.submit("gossip_aggregate", f"g{i}")
+            bp.submit("gossip_block", "B2")
+            gate.set()
+            assert bp.wait_idle(5.0)
+        finally:
+            gate.set()
+            bp.stop()
+        # journal: claims in dispatch order. Drop the two gated warmups.
+        tail = bp.journal[2:]
+        assert tail[0] == ("gossip_block", 1)  # B2 preempts everything
+        assert tail[1] == ("gossip_aggregate", 3)
+        assert tail[2] == ("gossip_attestation", 64)
+        assert tail[3] == ("gossip_attestation", 36)
+        assert tail[4] == ("gossip_sync_message", 5)
+        assert tail[5] == ("api_request", 1)
+        assert bp.processed["gossip_attestation"] == 100
+
+    def test_pool_executes_handlers_concurrently(self):
+        """Two workers must be able to hold two handlers open at once (a
+        slow block import cannot stall the attestation lane)."""
+        import threading
+
+        first_in = threading.Event()
+        release = threading.Event()
+        seen = []
+
+        def slow_block(b):
+            first_in.set()
+            release.wait(5.0)
+
+        bp = BeaconProcessor(
+            handlers={
+                "gossip_block": slow_block,
+                "gossip_attestation": lambda xs: seen.append(len(xs)),
+            },
+            max_workers=2,
+        )
+        bp.start()
+        try:
+            bp.submit("gossip_block", "B")
+            assert first_in.wait(5.0)
+            bp.submit("gossip_attestation", "a")
+            # the second worker drains attestations while block is held
+            for _ in range(200):
+                if seen:
+                    break
+                threading.Event().wait(0.005)
+            assert seen == [1]
+        finally:
+            release.set()
+            bp.stop()
+
+
+class TestTimeoutLock:
+    def test_timeout_raises_with_holder_named(self):
+        """timeout_rw_lock.rs semantics: a stuck holder surfaces as a loud
+        error naming the lock instead of a silent deadlock."""
+        import threading
+
+        from lighthouse_tpu.utils.timeout_lock import (
+            LockTimeoutError,
+            TimeoutRLock,
+        )
+
+        lock = TimeoutRLock("test_lock", timeout=0.05)
+        held = threading.Event()
+        release = threading.Event()
+
+        def holder():
+            with lock:
+                held.set()
+                release.wait(5.0)
+
+        t = threading.Thread(target=holder, daemon=True)
+        t.start()
+        assert held.wait(5.0)
+        try:
+            import pytest
+
+            with pytest.raises(LockTimeoutError, match="test_lock"):
+                with lock:
+                    pass
+        finally:
+            release.set()
+            t.join()
+
+    def test_reentrant(self):
+        from lighthouse_tpu.utils.timeout_lock import TimeoutRLock
+
+        lock = TimeoutRLock("re", timeout=0.5)
+        with lock:
+            with lock:  # process_block -> recompute_head nesting
+                pass

@@ -105,7 +105,12 @@ class TestPrunePayloads:
         full = h.store.get_block(head_root)
         assert hasattr(full.message.body, "execution_payload")
 
-        n = h.store.prune_payloads()
+        # default boundary is the hot/cold split (finalized) slot: with no
+        # finality yet nothing is pruned — head/unfinalized payloads survive
+        assert h.store.prune_payloads() == 0
+        n = h.store.prune_payloads(
+            before_slot=int(h.chain.head_state.slot) + 1
+        )
         assert n >= 3  # the bellatrix blocks
         blinded = h.store.get_block(head_root)
         assert hasattr(blinded.message.body, "execution_payload_header")

@@ -1,0 +1,21 @@
+#!/bin/bash
+# Tunnel watcher: probe until the axon TPU tunnel is up, then immediately
+# warm the jit cache (staged, resumable) and run the bench. Logs to
+# tpu_watch.log; exits after one warm+bench cycle so the session can react.
+cd /root/repo
+LOG=tpu_watch.log
+echo "[watch] start $(date -u +%H:%M:%S)" >> "$LOG"
+while true; do
+  timeout 45 python -c "import jax; assert jax.devices()[0].platform != 'cpu'" 2>/dev/null
+  if [ $? -eq 0 ]; then
+    echo "[watch] TUNNEL UP $(date -u +%H:%M:%S)" >> "$LOG"
+    break
+  fi
+  echo "[watch] down $(date -u +%H:%M:%S)" >> "$LOG"
+  sleep 240
+done
+echo "[watch] warming..." >> "$LOG"
+timeout 3600 python warm_tpu.py >> "$LOG" 2>&1
+echo "[watch] warm rc=$? $(date -u +%H:%M:%S); benching..." >> "$LOG"
+timeout 1200 python bench.py >> "$LOG" 2>&1
+echo "[watch] bench rc=$? done $(date -u +%H:%M:%S)" >> "$LOG"

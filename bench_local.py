@@ -72,6 +72,62 @@ def bench_verifier_mesh(n_sets: int = 8) -> dict:
     }
 
 
+def bench_verifier_mesh_curve(per_device_sets: int = 1) -> dict:
+    """Weak-scaling curve over mesh sizes 1/2/4/8 (BASELINE config 5,
+    block_signature_verifier.rs:374-384's rayon analogue): fixed per-device
+    sets, growing mesh. NOTE the honest caveat: these virtual devices share
+    ONE host CPU, so wall time GROWS with mesh size here — the curve
+    demonstrates sharding correctness and bounded collective overhead, not
+    speedup. Linear-throughput claims need real chips; the driver's
+    dryrun_multichip validates the same program compiles and executes on
+    an N-device mesh."""
+    import jax
+    from jax.sharding import NamedSharding, PartitionSpec
+
+    from __graft_entry__ import _example_batch
+    from lighthouse_tpu.parallel import make_sharded_verify, sets_mesh
+
+    devices = jax.devices("cpu")
+    curve = []
+    for n_dev in (1, 2, 4, 8):
+        if len(devices) < n_dev:
+            break
+        n_sets = per_device_sets * n_dev
+        mesh = sets_mesh(devices[:n_dev])
+        fn = make_sharded_verify(mesh)
+        args = _example_batch(
+            n_sets=n_sets, k_pk=2, distinct=min(n_sets, 8)
+        )
+        sharding = NamedSharding(mesh, PartitionSpec("sets"))
+        d_args = tuple(jax.device_put(a, sharding) for a in args)
+        t0 = time.perf_counter()
+        ok = bool(fn(*d_args))
+        compile_s = time.perf_counter() - t0
+        assert ok, f"mesh={n_dev} rejected a valid batch"
+        times = []
+        for _ in range(2):
+            t0 = time.perf_counter()
+            bool(fn(*d_args))
+            times.append(time.perf_counter() - t0)
+        best = min(times)
+        curve.append(
+            {
+                "n_devices": n_dev,
+                "n_sets": n_sets,
+                "steady_s": round(best, 3),
+                "sets_per_s": round(n_sets / best, 2),
+                "compile_s": round(compile_s, 2),
+            }
+        )
+    return {
+        "metric": "verifier_mesh_weak_scaling",
+        "value": curve[-1]["sets_per_s"] if curve else 0.0,
+        "curve": curve,
+        "note": "virtual devices share one host CPU: correctness + "
+        "overhead curve, not a speedup claim",
+    }
+
+
 def _synthetic_state(n_validators: int, fork: str = "phase0"):
     from lighthouse_tpu.types import MINIMAL, types_for
     from lighthouse_tpu.types.chain_spec import FAR_FUTURE_EPOCH
@@ -243,6 +299,7 @@ def main() -> None:
     if not mini:
         # compile-bound (minutes when the XLA cache is cold): full runs only
         results.append(bench_verifier_mesh(8))
+        results.append(bench_verifier_mesh_curve())
     results += [
         bench_epoch_transition(2_000 if mini else 100_000),
         bench_epoch_transition(2_000 if mini else 500_000, fork="altair"),

@@ -236,6 +236,80 @@ def verify_next_committee_branch(update) -> None:
         raise LightClientError("next sync committee branch does not verify")
 
 
+# -- update ranking (spec is_better_update) -----------------------------------
+
+
+def period_slots(preset) -> int:
+    """Slots per sync-committee period — the spec constant behind period
+    arithmetic, window rotation, and UPDATE_TIMEOUT."""
+    return preset.slots_per_epoch * preset.epochs_per_sync_committee_period
+
+
+def _period_of_slot(slot: int, preset) -> int:
+    return slot // period_slots(preset)
+
+
+def _epoch_of(slot: int, preset) -> int:
+    return slot // preset.slots_per_epoch
+
+
+def is_sync_committee_update(update) -> bool:
+    return any(bytes(h) != bytes(32) for h in update.next_sync_committee_branch)
+
+
+def is_finality_update(update) -> bool:
+    return any(bytes(h) != bytes(32) for h in update.finality_branch)
+
+
+def is_better_update(new, old, preset) -> bool:
+    """Spec is_better_update (altair sync protocol): full comparison
+    chain — supermajority, relevant sync-committee payload, finality,
+    finality-with-matching-committee, participation, attested-slot
+    recency, signature-slot recency. Drives the EF update_ranking vectors
+    and best_valid_update selection."""
+    new_bits = list(new.sync_aggregate.sync_committee_bits)
+    old_bits = list(old.sync_aggregate.sync_committee_bits)
+    max_active = len(new_bits)
+    new_active = sum(new_bits)
+    old_active = sum(old_bits)
+
+    new_supermajority = new_active * 3 >= max_active * 2
+    old_supermajority = old_active * 3 >= max_active * 2
+    if new_supermajority != old_supermajority:
+        return new_supermajority
+    if not new_supermajority and new_active != old_active:
+        return new_active > old_active
+
+    def relevant_committee(u) -> bool:
+        return is_sync_committee_update(u) and _period_of_slot(
+            int(u.attested_header.slot), preset
+        ) == _period_of_slot(int(u.signature_slot), preset)
+
+    new_rel, old_rel = relevant_committee(new), relevant_committee(old)
+    if new_rel != old_rel:
+        return new_rel
+
+    new_fin, old_fin = is_finality_update(new), is_finality_update(old)
+    if new_fin != old_fin:
+        return new_fin
+
+    def finality_with_committee(u, has_fin: bool) -> bool:
+        return has_fin and _period_of_slot(
+            int(u.finalized_header.slot), preset
+        ) == _period_of_slot(int(u.attested_header.slot), preset)
+
+    new_fwc = finality_with_committee(new, new_fin)
+    old_fwc = finality_with_committee(old, old_fin)
+    if new_fwc != old_fwc:
+        return new_fwc
+
+    if new_active != old_active:
+        return new_active > old_active
+    if int(new.attested_header.slot) != int(old.attested_header.slot):
+        return int(new.attested_header.slot) < int(old.attested_header.slot)
+    return int(new.signature_slot) < int(old.signature_slot)
+
+
 # -- the following light client ----------------------------------------------
 
 
@@ -266,23 +340,19 @@ class LightClientStore:
         self.current_max_active_participants = 0
         self._participation_window = 0
         self._last_local_window: int | None = None
+        # spec LightClientStore.best_valid_update: stashed for the
+        # UPDATE_TIMEOUT force-update path when finality stalls
+        self.best_valid_update = None
         # parsed-pubkey cache keyed by committee root: the committee is
         # fixed for a whole sync period (8192 slots on mainnet), so the
         # per-update deserialization of up to 512 keys amortizes to zero
         self._parsed_committees: dict[bytes, list] = {}
 
     def _period_of(self, slot: int) -> int:
-        return slot // (
-            self.preset.slots_per_epoch
-            * self.preset.epochs_per_sync_committee_period
-        )
+        return _period_of_slot(slot, self.preset)
 
     def _window_of(self, slot: int) -> int:
-        period_slots = (
-            self.preset.slots_per_epoch
-            * self.preset.epochs_per_sync_committee_period
-        )
-        return (2 * slot) // max(1, period_slots)
+        return (2 * slot) // max(1, period_slots(self.preset))
 
     def _rotate_to(self, window: int) -> None:
         if window == self._participation_window + 1:
@@ -332,7 +402,12 @@ class LightClientStore:
             // 2
         )
 
-    def _verify_sync_aggregate(self, update, supermajority: bool = True) -> None:
+    def _verify_sync_aggregate(
+        self,
+        update,
+        supermajority: bool = True,
+        min_participants: int | None = None,
+    ) -> None:
         from ..crypto.bls import (
             PublicKey,
             Signature,
@@ -349,7 +424,9 @@ class LightClientStore:
         # advance above the SAFETY THRESHOLD (spec get_safety_threshold:
         # strictly more than half the recent max participation) — liveness
         # at 34-66% participation without following a lone captured key.
-        if supermajority:
+        if min_participants is not None:
+            minimum = min_participants
+        elif supermajority:
             minimum = -(-2 * len(bits) // 3)
         else:
             minimum = max(1, self.safety_threshold() + 1)
@@ -466,3 +543,125 @@ class LightClientStore:
         self._verify_sync_aggregate(update, supermajority=False)
         if int(update.attested_header.slot) > int(self.optimistic_header.slot):
             self.optimistic_header = update.attested_header
+
+    # -- spec-shaped update machinery (EF light_client/sync vectors) --------
+
+    def _update_timeout(self) -> int:
+        # spec UPDATE_TIMEOUT: one sync-committee period of slots
+        return period_slots(self.preset)
+
+    def process_spec_update(self, update, current_slot: int) -> None:
+        """Full spec process_light_client_update: validate (signature,
+        slot ordering, period relevance, branches), stash
+        best_valid_update, advance the optimistic header past the safety
+        threshold, and APPLY on supermajority+finality — the exact shape
+        the EF light_client/sync vectors drive."""
+        bits = list(update.sync_aggregate.sync_committee_bits)
+        n_active = sum(bits)
+        sig_slot = int(update.signature_slot)
+        attested_slot = int(update.attested_header.slot)
+        finalized_slot = int(update.finalized_header.slot)
+        has_finality = is_finality_update(update)
+        has_committee = is_sync_committee_update(update)
+        if not (current_slot >= sig_slot):
+            raise LightClientError("update signed in the future")
+        if has_finality and attested_slot < finalized_slot:
+            raise LightClientError("attested before finalized")
+        store_period = self._period_of(int(self.finalized_header.slot))
+        sig_period = self._period_of(sig_slot)
+        attested_period = self._period_of(attested_slot)
+        if self.next_sync_committee is not None:
+            if sig_period not in (store_period, store_period + 1):
+                raise LightClientError("irrelevant signature period")
+        elif sig_period != store_period:
+            raise LightClientError("signature period without known committee")
+        update_has_next = (
+            self.next_sync_committee is None
+            and has_committee
+            and attested_period == store_period
+        )
+        if attested_slot <= int(self.finalized_header.slot) and not update_has_next:
+            raise LightClientError("update does not advance the store")
+        if has_finality:
+            verify_finality_branch(update)
+        if has_committee:
+            verify_next_committee_branch(update)
+        # spec validate: only MIN_SYNC_COMMITTEE_PARTICIPANTS gates here
+        self._verify_sync_aggregate(update, min_participants=1)
+
+        if self.best_valid_update is None or is_better_update(
+            update, self.best_valid_update, self.preset
+        ):
+            self.best_valid_update = update
+        if (
+            n_active > self.safety_threshold()
+            and attested_slot > int(self.optimistic_header.slot)
+        ):
+            self.optimistic_header = update.attested_header
+        update_has_finalized_next = (
+            update_has_next
+            and has_finality
+            and self._period_of(finalized_slot) == attested_period
+        )
+        if n_active * 3 >= len(bits) * 2 and (
+            finalized_slot > int(self.finalized_header.slot)
+            or update_has_finalized_next
+        ):
+            self._apply_spec_update(update)
+            self.best_valid_update = None
+
+    def _apply_spec_update(self, update) -> None:
+        """Spec apply_light_client_update: committee rotation across the
+        period boundary, then finalized/optimistic header advance."""
+        store_period = self._period_of(int(self.finalized_header.slot))
+        finalized_period = self._period_of(int(update.finalized_header.slot))
+        if self.next_sync_committee is None:
+            if finalized_period != store_period:
+                raise LightClientError(
+                    "cannot install next committee from another period"
+                )
+            self.next_sync_committee = update.next_sync_committee
+        elif finalized_period == store_period + 1:
+            self.current_sync_committee = self.next_sync_committee
+            self.next_sync_committee = (
+                update.next_sync_committee
+                if is_sync_committee_update(update)
+                else None
+            )
+            self.previous_max_active_participants = (
+                self.current_max_active_participants
+            )
+            self.current_max_active_participants = 0
+        if int(update.finalized_header.slot) > int(self.finalized_header.slot):
+            self.finalized_header = update.finalized_header
+            if int(self.finalized_header.slot) > int(
+                self.optimistic_header.slot
+            ):
+                self.optimistic_header = self.finalized_header
+
+    def force_update(self, current_slot: int) -> None:
+        """Spec process_light_client_store_force_update: when finality has
+        stalled for a whole UPDATE_TIMEOUT, advance from the best stashed
+        update, treating its attested header as finalized."""
+        if (
+            current_slot
+            <= int(self.finalized_header.slot) + self._update_timeout()
+            or self.best_valid_update is None
+        ):
+            return
+        best = self.best_valid_update
+        if int(best.finalized_header.slot) <= int(self.finalized_header.slot):
+            # promote the attested header (spec zeroes the finality proof
+            # and substitutes attested_header as the new finalized header)
+            lt = light_client_types(self.preset)
+            best = lt.LightClientUpdate(
+                attested_header=best.attested_header,
+                next_sync_committee=best.next_sync_committee,
+                next_sync_committee_branch=best.next_sync_committee_branch,
+                finalized_header=best.attested_header,
+                finality_branch=best.finality_branch,
+                sync_aggregate=best.sync_aggregate,
+                signature_slot=best.signature_slot,
+            )
+        self._apply_spec_update(best)
+        self.best_valid_update = None

@@ -97,6 +97,23 @@ def resolve_genesis(args, store, preset, spec, eth1_service=None):
             state.genesis_time, spec.seconds_per_slot
         )
         return chain
+    if mode == "checkpoint-url":
+        # ClientGenesis::CheckpointSyncUrl (builder.rs:206-340): fetch the
+        # finalized state+block pair from a trusted node's HTTP API
+        from .http_api import BeaconNodeHttpClient
+
+        url = getattr(args, "checkpoint_sync_url", None)
+        if not url:
+            raise SystemExit(
+                "--genesis checkpoint-url requires --checkpoint-sync-url"
+            )
+        client = BeaconNodeHttpClient(url, preset)
+        state, block = client.fetch_checkpoint_anchor()
+        chain = BeaconChain.from_anchor(store, state, block, preset, spec)
+        chain.slot_clock = SystemSlotClock(
+            state.genesis_time, spec.seconds_per_slot
+        )
+        return chain
     if mode == "deposit-contract":
         # ClientGenesis::DepositContract: poll the deposit contract until
         # a valid genesis forms (reference beacon_node/genesis service)
@@ -193,7 +210,9 @@ def build_beacon_node(args):
 
         bus = WireBus(preset)
         peer_id = getattr(args, "peer_id", None) or f"bn-{id(chain) & 0xFFFF}"
-        node.network = NetworkNode(peer_id, chain, bus)
+        # ONE operation pool: gossip ingestion and API/VC block production
+        # must see the same operations (and one persisted blob on shutdown)
+        node.network = NetworkNode(peer_id, chain, bus, op_pool=node.op_pool)
         bus.listen(peer_id, getattr(args, "listen_port", 0) or 0)
         if getattr(args, "bootnode", None):
             host, _, port = args.bootnode.partition(":")
@@ -277,6 +296,15 @@ def cmd_bn(args):
         monitoring.stop()
     server.stop()
     executor.join_all()
+    if hasattr(node, "network") and node.network.processor.is_running:
+        node.network.processor.stop()
+    # pooled operations survive the restart (persistence.rs shutdown hook)
+    try:
+        node.op_pool.persist(node.chain.store)
+        log.info("operation pool persisted",
+                 attestations=node.op_pool.num_attestations())
+    except Exception as e:  # noqa: BLE001 -- persistence is best-effort
+        log.warn("op-pool persist failed", error=str(e))
     return rc
 
 
@@ -557,7 +585,7 @@ def main(argv=None) -> int:
     bn.add_argument("--genesis-time", type=int, default=None)
     bn.add_argument("--genesis", default="interop",
                     choices=["interop", "resume", "checkpoint",
-                             "deposit-contract"],
+                             "checkpoint-url", "deposit-contract"],
                     help="genesis resolution (ClientGenesis equivalent; "
                          "deposit-contract waits for eth1 deposits)")
     bn.add_argument("--eth1-endpoint", default=None,
@@ -566,6 +594,8 @@ def main(argv=None) -> int:
     bn.add_argument("--genesis-timeout", type=float, default=600.0,
                     help="deposit-contract genesis: seconds to wait for "
                          "a valid genesis before giving up")
+    bn.add_argument("--checkpoint-sync-url", default=None,
+                    help="trusted node URL for --genesis checkpoint-url")
     bn.add_argument("--checkpoint-state", default=None,
                     help="SSZ file: finalized BeaconState anchor")
     bn.add_argument("--checkpoint-block", default=None,

@@ -119,7 +119,50 @@ _OPERATION_FILES = {
 }
 
 
+def _run_execution_payload_case(case_dir, config, fork) -> CaseResult:
+    """operations/execution_payload (cases/operations.rs:249-310): the
+    payload applies iff the engine verdict in execution.yaml says the
+    payload is executable AND the consensus checks pass."""
+    from types import SimpleNamespace
+
+    from .state_transition.per_block import process_execution_payload
+
+    if fork in ("phase0", "altair"):
+        return CaseResult(case_dir, True, "pre-bellatrix (skipped)")
+    preset, spec = _spec_for(config, fork)
+    t = types_for(preset)
+    state_cls = state_class_for(t, fork)
+    pre = state_cls.from_ssz_bytes(_load(case_dir, "pre.ssz_snappy"))
+    payload = t.ExecutionPayload.from_ssz_bytes(
+        _load(case_dir, "execution_payload.ssz_snappy")
+    )
+    meta = _load_yaml(case_dir, "execution.yaml") or {}
+    execution_valid = bool(meta.get("execution_valid", False))
+    post_raw = _load(case_dir, "post.ssz_snappy")
+    body = SimpleNamespace(execution_payload=payload)
+    error = None
+    try:
+        if not execution_valid:
+            raise BlockProcessingError("execution engine rejected payload")
+        process_execution_payload(pre, body, preset, spec)
+        applied = True
+    except (BlockProcessingError, IndexError, ValueError) as e:
+        applied = False
+        error = str(e)
+    if post_raw is None:
+        if applied:
+            return CaseResult(case_dir, False, "invalid payload accepted")
+        return CaseResult(case_dir, True)
+    if not applied:
+        return CaseResult(case_dir, False, f"valid payload rejected: {error}")
+    if pre.tree_hash_root() != state_cls.from_ssz_bytes(post_raw).tree_hash_root():
+        return CaseResult(case_dir, False, "post-state root mismatch")
+    return CaseResult(case_dir, True)
+
+
 def _run_operation_case(case_dir, handler, config, fork) -> CaseResult:
+    if handler == "execution_payload":
+        return _run_execution_payload_case(case_dir, config, fork)
     preset, spec = _spec_for(config, fork)
     t = types_for(preset)
     state_cls = state_class_for(t, fork)
@@ -894,8 +937,6 @@ def _run_merkle_proof_case(case_dir, handler, config, fork) -> CaseResult:
     )
 
     if handler not in ("single_merkle_proof", "single_proof"):
-        # the light_client runner also ships sync/update-ranking handlers
-        # that are out of this walker's scope
         return CaseResult(case_dir, True, "handler not in surface (skipped)")
     preset, spec = _spec_for(config, fork)
     t = types_for(preset)
@@ -934,9 +975,106 @@ def _run_merkle_proof_case(case_dir, handler, config, fork) -> CaseResult:
     return CaseResult(case_dir, True)
 
 
+def _run_update_ranking_case(case_dir, handler, config, fork) -> CaseResult:
+    """light_client/update_ranking: the vector's updates are ordered from
+    highest to lowest precedence; every later update must NOT rank better
+    than an earlier one (spec is_better_update)."""
+    from .chain.light_client import is_better_update, light_client_types
+
+    preset, _ = _spec_for(config, fork)
+    lt = light_client_types(preset)
+    meta = _load_yaml(case_dir, "meta.yaml") or {}
+    count = int(meta.get("updates_count", 0))
+    updates = [
+        lt.LightClientUpdate.from_ssz_bytes(
+            _load(case_dir, f"updates_{i}.ssz_snappy")
+        )
+        for i in range(count)
+    ]
+    for i in range(len(updates) - 1):
+        if is_better_update(updates[i + 1], updates[i], preset):
+            return CaseResult(
+                case_dir, False, f"update {i + 1} ranks above update {i}"
+            )
+        if not is_better_update(updates[i], updates[i + 1], preset):
+            return CaseResult(
+                case_dir, False, f"update {i} does not outrank {i + 1}"
+            )
+    return CaseResult(case_dir, True)
+
+
+def _run_light_client_sync_case(case_dir, handler, config, fork) -> CaseResult:
+    """light_client/sync: scripted steps driving a spec store —
+    process_update / force_update with finalized/optimistic header
+    checks after each step."""
+    from .chain.light_client import LightClientStore, light_client_types
+
+    preset, spec = _spec_for(config, fork)
+    lt = light_client_types(preset)
+    meta = _load_yaml(case_dir, "meta.yaml") or {}
+    trusted = bytes.fromhex(
+        str(meta["trusted_block_root"]).removeprefix("0x")
+    )
+    gvr = bytes.fromhex(
+        str(meta["genesis_validators_root"]).removeprefix("0x")
+    )
+    bootstrap = lt.LightClientBootstrap.from_ssz_bytes(
+        _load(case_dir, "bootstrap.ssz_snappy")
+    )
+    store = LightClientStore(trusted, bootstrap, preset, spec, gvr)
+    steps = _load_yaml(case_dir, "steps.yaml") or []
+
+    def _check(checks) -> str | None:
+        for name, want in (checks or {}).items():
+            header = getattr(store, name, None)
+            if header is None:
+                return f"unknown check target {name}"
+            if int(header.slot) != int(want["slot"]):
+                return f"{name} slot {header.slot} != {want['slot']}"
+            want_root = want.get("beacon_root", want.get("root"))
+            if want_root is not None and header.tree_hash_root() != (
+                bytes.fromhex(str(want_root).removeprefix("0x"))
+            ):
+                return f"{name} root mismatch"
+        return None
+
+    for step in steps:
+        if "process_update" in step:
+            p = step["process_update"]
+            update = lt.LightClientUpdate.from_ssz_bytes(
+                _load(case_dir, f"{p['update']}.ssz_snappy")
+            )
+            store.process_spec_update(update, int(p["current_slot"]))
+            err = _check(p.get("checks"))
+        elif "force_update" in step:
+            p = step["force_update"]
+            store.force_update(int(p["current_slot"]))
+            err = _check(p.get("checks"))
+        else:
+            # an unsupported step kind ends the case as an explicit SKIP —
+            # continuing would run later checks against missed state, and
+            # a bare pass would be a false green in a conformance runner
+            kind = next(iter(step), "?")
+            return CaseResult(
+                case_dir, True, f"skipped at unsupported step {kind!r}"
+            )
+        if err:
+            return CaseResult(case_dir, False, err)
+    return CaseResult(case_dir, True)
+
+
+def _run_light_client_case(case_dir, handler, config, fork) -> CaseResult:
+    if handler == "update_ranking":
+        return _run_update_ranking_case(case_dir, handler, config, fork)
+    if handler == "sync":
+        return _run_light_client_sync_case(case_dir, handler, config, fork)
+    return _run_merkle_proof_case(case_dir, handler, config, fork)
+
+
 _RUNNERS = {
     "operations": _run_operation_case,
     "sanity": _run_sanity_case,
+    "random": _run_sanity_case,
     "epoch_processing": _run_epoch_case,
     "bls": _run_bls_case,
     "genesis": _run_genesis_case,
@@ -946,7 +1084,7 @@ _RUNNERS = {
     "fork_choice": _run_fork_choice_case,
     "transition": _run_transition_case,
     "rewards": _run_rewards_case,
-    "light_client": _run_merkle_proof_case,
+    "light_client": _run_light_client_case,
     "merkle": _run_merkle_proof_case,
     "merkle_proof": _run_merkle_proof_case,
     "ssz_generic": _run_ssz_generic_case,

@@ -66,6 +66,10 @@ class BeaconApi:
             return self.chain.head_root
         if block_id == "genesis":
             return self.chain.genesis_block_root
+        if block_id == "finalized":
+            # serves URL-style checkpoint sync (builder.rs:206-340 fetches
+            # the finalized block + state pair from a trusted node)
+            return self.chain.finalized_checkpoint[1]
         if block_id.startswith("0x"):
             return unhex(block_id)
         raise ApiError(400, f"unsupported block id {block_id}")
@@ -860,6 +864,138 @@ class BeaconApi:
                 "previous_epoch_head_attesting_gwei": str(head_gwei),
             }
         }
+
+    def lighthouse_validator_inclusion_validator(
+        self, epoch: int, validator_id: str
+    ) -> dict:
+        """Single-validator inclusion for an epoch
+        (validator_inclusion.rs validator_inclusion_data): slashed /
+        withdrawable / active status plus per-flag attestation hits from
+        the participation bits."""
+        from ..state_transition.participation import (
+            TIMELY_HEAD_FLAG_INDEX,
+            TIMELY_SOURCE_FLAG_INDEX,
+            TIMELY_TARGET_FLAG_INDEX,
+            has_flag,
+        )
+        from ..types import is_active_validator
+
+        s = self.chain.head_state
+        head_epoch = compute_epoch_at_slot(s.slot, self.chain.preset)
+        if epoch != max(head_epoch - 1, 0):
+            raise ApiError(
+                400,
+                f"inclusion data only available for epoch {max(head_epoch - 1, 0)}",
+            )
+        if validator_id.startswith("0x"):
+            pubkey = unhex(validator_id)
+            index = next(
+                (
+                    i
+                    for i, v in enumerate(s.validators)
+                    if bytes(v.pubkey) == pubkey
+                ),
+                None,
+            )
+        else:
+            index = int(validator_id)
+        if index is None or index >= len(s.validators):
+            raise ApiError(404, f"unknown validator {validator_id}")
+        v = s.validators[index]
+        flags = (
+            s.previous_epoch_participation[index]
+            if hasattr(s, "previous_epoch_participation")
+            else 0
+        )
+        active = is_active_validator(v, epoch)
+        return {
+            "data": {
+                "is_slashed": bool(v.slashed),
+                "is_withdrawable_in_current_epoch": (
+                    epoch >= v.withdrawable_epoch
+                ),
+                "is_active_unslashed_in_previous_epoch": (
+                    active and not v.slashed
+                ),
+                "current_epoch_effective_balance_gwei": str(
+                    v.effective_balance
+                ),
+                "is_previous_epoch_source_attester": bool(
+                    has_flag(flags, TIMELY_SOURCE_FLAG_INDEX)
+                ),
+                "is_previous_epoch_target_attester": bool(
+                    has_flag(flags, TIMELY_TARGET_FLAG_INDEX)
+                ),
+                "is_previous_epoch_head_attester": bool(
+                    has_flag(flags, TIMELY_HEAD_FLAG_INDEX)
+                ),
+            }
+        }
+
+    def _state_at_slot(self, slot: int):
+        """Historical state resolution: authoritative cold path below the
+        split, the state_at_slot hot index above it."""
+        store = self.chain.store
+        if slot < store.split_slot:
+            try:
+                return store.load_cold_state(slot)
+            except KeyError:
+                # unreconstructable cold slot (no restore point below, or
+                # a documented state-root gap): this epoch is unavailable,
+                # not the whole response
+                return None
+        from ..store.kv import slot_key
+
+        root = store.get_chain_item(b"state_at_slot:" + slot_key(slot))
+        if root is None:
+            return None
+        try:
+            return store.get_state(root)
+        except KeyError:
+            return None
+
+    def lighthouse_attestation_performance(
+        self, index: int, start_epoch: int, end_epoch: int
+    ) -> dict:
+        """Per-epoch attestation performance for one validator across a
+        historical range (attestation_performance.rs): epoch E's
+        participation flags live in the previous_epoch_participation of
+        the state at the first slot of E+1."""
+        from ..state_transition.participation import (
+            TIMELY_HEAD_FLAG_INDEX,
+            TIMELY_SOURCE_FLAG_INDEX,
+            TIMELY_TARGET_FLAG_INDEX,
+            has_flag,
+        )
+        from ..types import is_active_validator
+
+        if end_epoch < start_epoch or end_epoch - start_epoch > 32:
+            raise ApiError(400, "bad epoch range (max 32 epochs)")
+        spe = self.chain.preset.slots_per_epoch
+        epochs = []
+        for epoch in range(start_epoch, end_epoch + 1):
+            state = self._state_at_slot((epoch + 1) * spe)
+            if state is None or not hasattr(
+                state, "previous_epoch_participation"
+            ):
+                epochs.append({"epoch": str(epoch), "available": False})
+                continue
+            if index >= len(state.validators):
+                epochs.append({"epoch": str(epoch), "available": False})
+                continue
+            v = state.validators[index]
+            flags = state.previous_epoch_participation[index]
+            epochs.append(
+                {
+                    "epoch": str(epoch),
+                    "available": True,
+                    "active": is_active_validator(v, epoch),
+                    "source": bool(has_flag(flags, TIMELY_SOURCE_FLAG_INDEX)),
+                    "target": bool(has_flag(flags, TIMELY_TARGET_FLAG_INDEX)),
+                    "head": bool(has_flag(flags, TIMELY_HEAD_FLAG_INDEX)),
+                }
+            )
+        return {"data": {"index": str(index), "epochs": epochs}}
 
     def lighthouse_database_info(self) -> dict:
         store = self.chain.store

@@ -280,3 +280,25 @@ class BeaconNodeHttpClient:
         return cls.from_ssz_bytes(
             bytes.fromhex(resp["data"]["ssz"].removeprefix("0x"))
         )
+
+    def get_block(self, block_id: str = "head"):
+        """Decoded SignedBeaconBlock for any block id."""
+        from ..types import block_classes_for
+
+        resp = self._get(f"/eth/v2/beacon/blocks/{block_id}")
+        t = types_for(self.preset)
+        _, signed_cls, _ = block_classes_for(t, resp["version"])
+        return signed_cls.from_ssz_bytes(
+            bytes.fromhex(resp["data"]["ssz"].removeprefix("0x"))
+        )
+
+    def fetch_checkpoint_anchor(self):
+        """The finalized (state, block) anchor pair for URL-style
+        checkpoint sync (reference client/src/builder.rs:206-340): the
+        block names its post-state root, so the state is fetched BY THAT
+        ROOT — immune to the head advancing between the two requests."""
+        block = self.get_block("finalized")
+        state = self.debug_state(
+            "0x" + bytes(block.message.state_root).hex()
+        )
+        return state, block

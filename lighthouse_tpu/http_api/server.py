@@ -67,6 +67,12 @@ class BeaconApiServer:
                     p.split("=", 1) for p in query.split("&") if "=" in p
                 )
 
+                def q(name: str) -> str:
+                    # a missing required query param is the CLIENT's error
+                    if name not in params:
+                        raise ApiError(400, f"missing query param {name}")
+                    return params[name]
+
                 routes_get = [
                     (r"^/eth/v1/beacon/genesis$", lambda m: api.get_genesis()),
                     (
@@ -231,6 +237,20 @@ class BeaconApiServer:
                         ),
                     ),
                     (
+                        r"^/lighthouse/validator_inclusion/(\d+)/([^/]+)$",
+                        lambda m: api.lighthouse_validator_inclusion_validator(
+                            int(m.group(1)), m.group(2)
+                        ),
+                    ),
+                    (
+                        r"^/lighthouse/analysis/attestation_performance/(\d+)$",
+                        lambda m: api.lighthouse_attestation_performance(
+                            int(m.group(1)),
+                            int(q("start_epoch")),
+                            int(q("end_epoch")),
+                        ),
+                    ),
+                    (
                         r"^/lighthouse/database/info$",
                         lambda m: api.lighthouse_database_info(),
                     ),
@@ -245,13 +265,13 @@ class BeaconApiServer:
                     (
                         r"^/lighthouse/analysis/block_packing$",
                         lambda m: api.lighthouse_block_packing(
-                            int(params["start_slot"]), int(params["end_slot"])
+                            int(q("start_slot")), int(q("end_slot"))
                         ),
                     ),
                     (
                         r"^/lighthouse/analysis/block_rewards$",
                         lambda m: api.lighthouse_block_rewards(
-                            int(params["start_slot"]), int(params["end_slot"])
+                            int(q("start_slot")), int(q("end_slot"))
                         ),
                     ),
                 ]

@@ -50,11 +50,16 @@ class NetworkNode:
         chain: BeaconChain,
         bus: MessageBus,
         subscribe_all_subnets: bool = True,
+        op_pool=None,
     ):
         self.peer_id = peer_id
         self.chain = chain
         self.bus = bus
-        self.op_pool = OperationPool(chain.preset, chain.spec)
+        # shared with the API node when the CLI wires one in; loads any
+        # persisted operations either way (persistence.rs)
+        self.op_pool = op_pool or OperationPool.load(
+            chain.store, chain.preset, chain.spec
+        )
         self.naive_pool = NaiveAggregationPool()
         self.observed_attesters = ObservedAttesters()
         self.observed_aggregates = ObservedAggregates()
@@ -167,10 +172,12 @@ class NetworkNode:
         from ..processor.reprocess import ReprocessQueue
         from ..utils.timeout_lock import TimeoutRLock
 
-        # serializes pool/cache mutation across gossip workers (the chain
-        # has its own lock; op/naive/sync pools, observed-* dedup caches,
-        # and the reprocess queue are guarded here). Block import runs
-        # OUTSIDE this lock so a slow import still overlaps batch verify.
+        # serializes the four BATCH gossip lanes against each other (the
+        # chain has its own lock; op/naive/sync pools and the observed-*
+        # dedup caches are mutated inside batch_verify_* itself, so the
+        # verify call cannot run outside the guard without splitting
+        # dedup from verification). Block import — the long pole — runs
+        # OUTSIDE this lock and overlaps every batch lane.
         self.pools_lock = TimeoutRLock("gossip_pools")
 
         sps = chain.spec.seconds_per_slot

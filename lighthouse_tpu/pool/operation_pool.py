@@ -156,6 +156,78 @@ class OperationPool:
         ]
         return proposer, attester, exits
 
+    # -- persistence (operation_pool/src/persistence.rs) --------------------
+    #
+    # The pool survives restarts: every held operation serializes as its
+    # SSZ container into one length-framed blob under the CHAIN column.
+    # Reload replays each item through the normal insert path, so dedup /
+    # subset rules apply identically to restored state.
+
+    _PERSIST_KEY = b"op_pool_v1"
+
+    def persist(self, store) -> None:
+        import struct as _s
+
+        from ..types.containers import types_for
+
+        t = types_for(self.preset)
+        sections: list[list[bytes]] = [[], [], [], []]
+        for entry in self._attestations.values():
+            for bits, sig in entry["variants"]:
+                att = t.Attestation(
+                    aggregation_bits=list(bits),
+                    data=entry["data"],
+                    signature=sig,
+                )
+                sections[0].append(att.as_ssz_bytes())
+        for s in self._proposer_slashings.values():
+            sections[1].append(s.as_ssz_bytes())
+        for s in self._attester_slashings:
+            sections[2].append(s.as_ssz_bytes())
+        for e in self._voluntary_exits.values():
+            sections[3].append(e.as_ssz_bytes())
+        out = bytearray()
+        for items in sections:
+            out += _s.pack(">I", len(items))
+            for blob in items:
+                out += _s.pack(">I", len(blob)) + blob
+        store.put_chain_item(self._PERSIST_KEY, bytes(out))
+
+    @classmethod
+    def load(cls, store, preset: Preset, spec) -> "OperationPool":
+        import struct as _s
+
+        from ..types.containers import types_for
+
+        pool = cls(preset, spec)
+        blob = store.get_chain_item(cls._PERSIST_KEY)
+        if not blob:
+            return pool
+        from ..types.containers import ProposerSlashing, SignedVoluntaryExit
+
+        t = types_for(preset)
+        decoders = [
+            (t.Attestation, pool.insert_attestation),
+            (ProposerSlashing, pool.insert_proposer_slashing),
+            (t.AttesterSlashing, pool.insert_attester_slashing),
+            (SignedVoluntaryExit, pool.insert_voluntary_exit),
+        ]
+        try:
+            off = 0
+            for cls_, insert in decoders:
+                (count,) = _s.unpack_from(">I", blob, off)
+                off += 4
+                for _ in range(count):
+                    (ln,) = _s.unpack_from(">I", blob, off)
+                    off += 4
+                    insert(cls_.from_ssz_bytes(blob[off : off + ln]))
+                    off += ln
+        except Exception:  # noqa: BLE001 -- persistence is best-effort BOTH
+            # ways: a corrupt/truncated blob (crash mid-write) must not
+            # crash-loop node startup; restart with whatever decoded
+            pass
+        return pool
+
     # -- pruning (lib.rs prune_* on finalization) ---------------------------
 
     def prune(self, state) -> None:

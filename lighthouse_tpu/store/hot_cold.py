@@ -284,10 +284,12 @@ class HotColdDB:
         state's ring buffer, so long non-finality cannot punch holes."""
         writer = _ChunkWriter(self.kv)
         migrated.sort()
+        cursor = 0
         prev = self.cold_block_root_at_slot(old_split - 1) if old_split else None
         for slot in range(old_split, finalized_slot):
-            while migrated and migrated[0][0] <= slot:
-                prev = migrated.pop(0)[1]
+            while cursor < len(migrated) and migrated[cursor][0] <= slot:
+                prev = migrated[cursor][1]
+                cursor += 1
             if prev is None:
                 # before the first canonical block: slot 0's "block" is the
                 # genesis header, recorded at chain init. Databases that

@@ -56,7 +56,11 @@ def interop_genesis_state(
     elif fork_name == "altair":
         state_cls = t.BeaconStateAltair
         version = spec.altair_fork_version
-        prev_version = spec.genesis_fork_version
+        prev_version = spec.altair_fork_version
+    elif fork_name == "bellatrix":
+        state_cls = t.BeaconStateBellatrix
+        version = spec.bellatrix_fork_version
+        prev_version = spec.bellatrix_fork_version
     else:
         raise ValueError(f"unsupported genesis fork {fork_name}")
 

@@ -47,7 +47,11 @@ class InProcessBeaconNode:
         self.chain = chain
         self.preset: Preset = chain.preset
         self.spec = chain.spec
-        self.op_pool = op_pool or OperationPool(chain.preset, chain.spec)
+        # restart-surviving pool (operation_pool/src/persistence.rs):
+        # reload persisted operations from the chain's store
+        self.op_pool = op_pool or OperationPool.load(
+            chain.store, chain.preset, chain.spec
+        )
         self.naive_pool = naive_pool or NaiveAggregationPool()
         self.sync_message_pool = sync_message_pool or SyncMessagePool(
             chain.preset

@@ -16,10 +16,10 @@ import yaml
 
 from lighthouse_tpu.crypto.bls import SecretKey, set_backend
 from lighthouse_tpu.ef_tests import run_tree
-from lighthouse_tpu.harness import StateHarness
+from lighthouse_tpu.harness import BeaconChainHarness, StateHarness
 from lighthouse_tpu.network.snappy import compress
 from lighthouse_tpu.state_transition import clone_state, process_epoch, process_slots
-from lighthouse_tpu.types import MINIMAL, ChainSpec
+from lighthouse_tpu.types import MINIMAL, ChainSpec, types_for
 
 SLOTS = MINIMAL.slots_per_epoch
 
@@ -594,6 +594,219 @@ def mini_tree(tmp_path_factory):
             "output": False,
         },
     )
+    # random/random: the sanity-blocks shape under the random runner
+    # (handler.rs:370-388 RandomHandler reuses SanityBlocks)
+    h_rand = StateHarness(32, MINIMAL, ChainSpec.minimal(), sign=False)
+    case = (
+        root / "tests" / "minimal" / "phase0" / "random" / "random"
+        / "pyspec_tests" / "two_blocks"
+    )
+    pre_rand = clone_state(h_rand.state)
+    rand_blocks = []
+    for slot in (1, 3):  # an empty slot in between exercises slot advance
+        signed, post_rand = h_rand.produce_block(slot)
+        h_rand.state = post_rand
+        rand_blocks.append(signed)
+    _write(case, "pre.ssz_snappy", pre_rand.as_ssz_bytes())
+    for i, b in enumerate(rand_blocks):
+        _write(case, f"blocks_{i}.ssz_snappy", b.as_ssz_bytes())
+    _write_yaml(case, "meta.yaml", {"blocks_count": 2})
+    _write(case, "post.ssz_snappy", post_rand.as_ssz_bytes())
+
+    # operations/execution_payload under bellatrix (operations.rs:249-310):
+    # engine-valid payload applies; engine-invalid must reject
+    from types import SimpleNamespace as _NS
+
+    from lighthouse_tpu.state_transition.per_block import (
+        compute_timestamp_at_slot,
+        process_execution_payload,
+    )
+    from lighthouse_tpu.types.helpers import get_randao_mix
+
+    spec_bell = ChainSpec.minimal()
+    spec_bell.altair_fork_epoch = 0
+    spec_bell.bellatrix_fork_epoch = 0
+    h_bell = StateHarness(32, MINIMAL, spec_bell, sign=False)
+    bell_state = process_slots(clone_state(h_bell.state), 1, MINIMAL, spec_bell)
+    t_min = types_for(MINIMAL)
+    epoch_now = bell_state.slot // SLOTS
+    payload = t_min.ExecutionPayload.default()
+    payload.parent_hash = b"\x22" * 32
+    payload.block_hash = b"\x33" * 32
+    payload.prev_randao = bytes(
+        get_randao_mix(bell_state, epoch_now, MINIMAL)
+    )
+    payload.timestamp = compute_timestamp_at_slot(
+        bell_state, bell_state.slot, spec_bell
+    )
+    case = (
+        root / "tests" / "minimal" / "bellatrix" / "operations"
+        / "execution_payload" / "pyspec_tests" / "valid_payload"
+    )
+    _write(case, "pre.ssz_snappy", bell_state.as_ssz_bytes())
+    _write(case, "execution_payload.ssz_snappy", payload.as_ssz_bytes())
+    _write_yaml(case, "execution.yaml", {"execution_valid": True})
+    post_bell = clone_state(bell_state)
+    process_execution_payload(
+        post_bell, _NS(execution_payload=payload), MINIMAL, spec_bell
+    )
+    _write(case, "post.ssz_snappy", post_bell.as_ssz_bytes())
+    case = (
+        root / "tests" / "minimal" / "bellatrix" / "operations"
+        / "execution_payload" / "pyspec_tests" / "engine_invalid"
+    )
+    _write(case, "pre.ssz_snappy", bell_state.as_ssz_bytes())
+    _write(case, "execution_payload.ssz_snappy", payload.as_ssz_bytes())
+    _write_yaml(case, "execution.yaml", {"execution_valid": False})
+    case = (
+        root / "tests" / "minimal" / "bellatrix" / "operations"
+        / "execution_payload" / "pyspec_tests" / "bad_prev_randao"
+    )
+    bad_payload = t_min.ExecutionPayload.from_ssz_bytes(payload.as_ssz_bytes())
+    bad_payload.prev_randao = b"\x55" * 32
+    _write(case, "pre.ssz_snappy", bell_state.as_ssz_bytes())
+    _write(case, "execution_payload.ssz_snappy", bad_payload.as_ssz_bytes())
+    _write_yaml(case, "execution.yaml", {"execution_valid": True})
+
+    # light_client/update_ranking: three updates in strictly descending
+    # precedence (committee+finality > finality > sub-supermajority)
+    from lighthouse_tpu.chain.light_client import (
+        light_client_types,
+        light_client_update,
+    )
+    from lighthouse_tpu.types.containers import header_from_block
+
+    lt_min = light_client_types(MINIMAL)
+    spec_lc = ChainSpec.minimal()
+    spec_lc.altair_fork_epoch = 0
+    h_lc = BeaconChainHarness(16, MINIMAL, spec_lc, sign=False)
+    h_lc.extend_chain(4 * SLOTS, attest=True)
+    lc_state = h_lc.chain.head_state
+    fin_root_lc = bytes(lc_state.finalized_checkpoint.root)
+    fin_block_lc = h_lc.chain.store.get_block_any_temperature(fin_root_lc)
+    fin_header_lc = header_from_block(fin_block_lc.message)
+    n_comm = len(list(lc_state.current_sync_committee.pubkeys))
+
+    def _agg(n_bits):
+        return t_min.SyncAggregate(
+            sync_committee_bits=[i < n_bits for i in range(n_comm)],
+            sync_committee_signature=b"\xaa" + b"\x00" * 95,
+        )
+
+    sig_slot_lc = int(lc_state.slot) + 1
+    u_full = light_client_update(
+        lc_state, fin_header_lc, _agg(n_comm), sig_slot_lc, MINIMAL
+    )
+    u_fin = lt_min.LightClientUpdate.from_ssz_bytes(u_full.as_ssz_bytes())
+    u_fin.next_sync_committee_branch = tuple(
+        bytes(32) for _ in u_fin.next_sync_committee_branch
+    )
+    u_weak = lt_min.LightClientUpdate.from_ssz_bytes(u_fin.as_ssz_bytes())
+    u_weak.sync_aggregate = _agg(n_comm // 2)
+    case = (
+        root / "tests" / "minimal" / "altair" / "light_client"
+        / "update_ranking" / "pyspec_tests" / "ranked"
+    )
+    for i, u in enumerate((u_full, u_fin, u_weak)):
+        _write(case, f"updates_{i}.ssz_snappy", u.as_ssz_bytes())
+    _write_yaml(case, "meta.yaml", {"updates_count": 3})
+
+    # light_client/sync: bootstrap -> finality update -> stalled
+    # optimistic update -> force_update after the timeout
+    from lighthouse_tpu.chain.light_client import light_client_bootstrap
+
+    fin_state_lc = h_lc.chain._states.get(fin_root_lc)
+    boot_lc = light_client_bootstrap(fin_state_lc, MINIMAL)
+    boot_lc.header = header_from_block(fin_block_lc.message)
+    case = (
+        root / "tests" / "minimal" / "altair" / "light_client"
+        / "sync" / "pyspec_tests" / "finality_then_force"
+    )
+    _write(case, "bootstrap.ssz_snappy", boot_lc.as_ssz_bytes())
+    _write(case, "update_0.ssz_snappy", u_full.as_ssz_bytes())
+    # newer BLOCKS without attestations: the chain head advances but
+    # finality stalls, so the update only stashes best_valid_update
+    h_lc.extend_chain(2, attest=False)
+    adv_state = h_lc.chain.head_state
+    u_stall = light_client_update(
+        adv_state,
+        fin_header_lc,
+        _agg(n_comm),
+        int(adv_state.slot) + 1,
+        MINIMAL,
+    )
+    u_stall.next_sync_committee_branch = tuple(
+        bytes(32) for _ in u_stall.next_sync_committee_branch
+    )
+    u_stall.finality_branch = tuple(
+        bytes(32) for _ in u_stall.finality_branch
+    )
+    u_stall.finalized_header = type(u_stall.finalized_header).default()
+    _write(case, "update_1.ssz_snappy", u_stall.as_ssz_bytes())
+    period_slots = SLOTS * MINIMAL.epochs_per_sync_committee_period
+    attested_root = u_full.attested_header.tree_hash_root()
+    stall_root = u_stall.attested_header.tree_hash_root()
+    _write_yaml(
+        case,
+        "meta.yaml",
+        {
+            "trusted_block_root": "0x" + fin_root_lc.hex(),
+            "genesis_validators_root": "0x"
+            + bytes(lc_state.genesis_validators_root).hex(),
+        },
+    )
+    _write_yaml(
+        case,
+        "steps.yaml",
+        [
+            {
+                "process_update": {
+                    "update": "update_0",
+                    "current_slot": sig_slot_lc,
+                    "checks": {
+                        "finalized_header": {
+                            "slot": int(fin_header_lc.slot),
+                            "beacon_root": "0x" + fin_root_lc.hex(),
+                        },
+                        "optimistic_header": {
+                            "slot": int(lc_state.slot),
+                            "beacon_root": "0x" + attested_root.hex(),
+                        },
+                    },
+                }
+            },
+            {
+                "process_update": {
+                    "update": "update_1",
+                    "current_slot": int(adv_state.slot) + 1,
+                    "checks": {
+                        "finalized_header": {
+                            "slot": int(fin_header_lc.slot),
+                            "beacon_root": "0x" + fin_root_lc.hex(),
+                        },
+                        "optimistic_header": {
+                            "slot": int(adv_state.slot),
+                            "beacon_root": "0x" + stall_root.hex(),
+                        },
+                    },
+                }
+            },
+            {
+                "force_update": {
+                    "current_slot": int(fin_header_lc.slot)
+                    + period_slots
+                    + 2,
+                    "checks": {
+                        "finalized_header": {
+                            "slot": int(adv_state.slot),
+                            "beacon_root": "0x" + stall_root.hex(),
+                        },
+                    },
+                }
+            },
+        ],
+    )
+
     return str(root)
 
 
@@ -604,8 +817,9 @@ def test_mini_tree_state_cases(mini_tree):
     assert not failures, failures
     # slots, 2x blocks, exit, epoch, 3x genesis validity, genesis init,
     # altair fork, shuffling, 2x ssz_static, fork_choice, transition,
-    # 2x rewards, light-client merkle proof
-    assert len(results) == 18
+    # 2x rewards, light-client merkle proof + update_ranking + sync,
+    # random, 3x execution_payload
+    assert len(results) == 24
 
 
 def test_mini_tree_bls_cases_on_jax_backend(mini_tree):

@@ -364,3 +364,64 @@ class TestLighthouseExtensions:
             assert all(r["block_root"].startswith("0x") for r in rows)
         finally:
             server.stop()
+
+
+class TestLighthouseAnalysisRoutes:
+    """Per-validator inclusion + historical attestation performance
+    (validator_inclusion.rs validator_inclusion_data,
+    attestation_performance.rs)."""
+
+    def _rig(self):
+        h = BeaconChainHarness(
+            16, MINIMAL, ChainSpec.interop(altair_fork_epoch=0)
+        )
+        node = InProcessBeaconNode(h.chain)
+        server = BeaconApiServer(BeaconApi(node))
+        server.start()
+        client = BeaconNodeHttpClient(
+            f"http://127.0.0.1:{server.port}", MINIMAL
+        )
+        return h, server, client
+
+    def test_per_validator_inclusion(self):
+        h, server, client = self._rig()
+        try:
+            h.extend_chain(2 * MINIMAL.slots_per_epoch)
+            data = client._get("/lighthouse/validator_inclusion/1/3")["data"]
+            assert data["is_slashed"] is False
+            assert data["is_previous_epoch_target_attester"] is True
+            assert data["current_epoch_effective_balance_gwei"] == str(
+                32 * 10**9
+            )
+            # pubkey addressing resolves to the same record
+            pk = "0x" + bytes(
+                h.chain.head_state.validators[3].pubkey
+            ).hex()
+            by_pk = client._get(
+                f"/lighthouse/validator_inclusion/1/{pk}"
+            )["data"]
+            assert by_pk == data
+        finally:
+            server.stop()
+
+    def test_attestation_performance_over_epochs(self):
+        h, server, client = self._rig()
+        try:
+            h.extend_chain(4 * MINIMAL.slots_per_epoch)
+            data = client._get(
+                "/lighthouse/analysis/attestation_performance/2"
+                "?start_epoch=1&end_epoch=2"
+            )["data"]
+            assert data["index"] == "2"
+            rows = {r["epoch"]: r for r in data["epochs"]}
+            assert rows["1"]["available"] and rows["1"]["target"]
+            assert rows["2"]["available"] and rows["2"]["head"]
+            from lighthouse_tpu.http_api.client import Eth2ClientError
+
+            with pytest.raises(Eth2ClientError, match="400"):
+                client._get(
+                    "/lighthouse/analysis/attestation_performance/2"
+                    "?start_epoch=0&end_epoch=99"
+                )
+        finally:
+            server.stop()

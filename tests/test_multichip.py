@@ -169,3 +169,20 @@ class TestGeneratorPairCountedOnce:
         single = bool(verify_jit(*args))
         multi = bool(sharded(*args))
         assert single == multi == False  # noqa: E712
+
+
+@pytest.mark.skipif(
+    "LIGHTHOUSE_TPU_MESH_CURVE" not in __import__("os").environ,
+    reason="mesh-size sweep compiles 3 extra XLA programs; opt-in via "
+    "LIGHTHOUSE_TPU_MESH_CURVE=1 (bench_local.py runs the same sweep)",
+)
+@pytest.mark.parametrize("n_dev", [1, 2, 4])
+def test_sharded_verify_correct_at_every_mesh_size(n_dev, valid_args):
+    """The sharded program must agree with the single-device kernel at
+    every mesh size, not only the 8-device one the suite pins."""
+    devices = jax.devices("cpu")
+    if len(devices) < n_dev:
+        pytest.skip(f"need {n_dev} devices")
+    mesh_n = sets_mesh(devices[:n_dev])
+    fn = make_sharded_verify(mesh_n)
+    assert bool(fn(*valid_args)) == bool(verify_jit(*valid_args))

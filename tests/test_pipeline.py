@@ -347,3 +347,67 @@ class TestTimeoutLock:
         with lock:
             with lock:  # process_block -> recompute_head nesting
                 pass
+
+
+class TestOpPoolPersistence:
+    def test_pool_round_trips_through_store(self):
+        """operation_pool/src/persistence.rs: held operations survive a
+        restart — persisted to the store, reloaded through the normal
+        insert paths so dedup rules apply to restored state too."""
+        from lighthouse_tpu.harness.chain import StateHarness
+        from lighthouse_tpu.pool import OperationPool
+        from lighthouse_tpu.store.hot_cold import HotColdDB
+        from lighthouse_tpu.store.kv import MemoryStore
+        from lighthouse_tpu.types import MINIMAL, ChainSpec, types_for
+        from lighthouse_tpu.types.containers import (
+            ProposerSlashing,
+            SignedBeaconBlockHeader,
+            SignedVoluntaryExit,
+            VoluntaryExit,
+        )
+
+        from lighthouse_tpu.state_transition import clone_state, process_slots
+
+        h = StateHarness(16, MINIMAL, sign=False)
+        t = types_for(MINIMAL)
+        store = HotColdDB(MemoryStore(), MINIMAL, h.spec)
+        pool = OperationPool(MINIMAL, h.spec)
+        state = process_slots(clone_state(h.state), 3, MINIMAL, h.spec)
+        atts = h.attestations_for_slot(state, 2)
+        for a in atts:
+            pool.insert_attestation(a)
+        pool.insert_voluntary_exit(
+            SignedVoluntaryExit(
+                message=VoluntaryExit(epoch=0, validator_index=3),
+                signature=b"\x11" * 96,
+            )
+        )
+        hdr = SignedBeaconBlockHeader.default()
+        hdr.message.proposer_index = 5
+        hdr2 = SignedBeaconBlockHeader.default()
+        hdr2.message.proposer_index = 5
+        hdr2.message.slot = 1
+        pool.insert_proposer_slashing(
+            ProposerSlashing(signed_header_1=hdr, signed_header_2=hdr2)
+        )
+
+        pool.persist(store)
+        restored = OperationPool.load(store, MINIMAL, h.spec)
+        assert restored.num_attestations() == pool.num_attestations()
+        assert 3 in restored._voluntary_exits
+        assert 5 in restored._proposer_slashings
+        # restored aggregates still pack identically
+        assert {
+            bytes(r) for r in restored._attestations
+        } == {bytes(r) for r in pool._attestations}
+
+    def test_load_empty_store_gives_empty_pool(self):
+        from lighthouse_tpu.pool import OperationPool
+        from lighthouse_tpu.store.hot_cold import HotColdDB
+        from lighthouse_tpu.store.kv import MemoryStore
+        from lighthouse_tpu.types import MINIMAL, ChainSpec
+
+        spec = ChainSpec.interop()
+        store = HotColdDB(MemoryStore(), MINIMAL, spec)
+        pool = OperationPool.load(store, MINIMAL, spec)
+        assert pool.num_attestations() == 0

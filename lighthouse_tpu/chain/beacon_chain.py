@@ -579,6 +579,13 @@ class BeaconChain:
             )
             if state_root is not None:
                 self.store.put_chain_item(b"head_state_root", state_root)
+            if self.validator_monitor is not None:
+                # per-epoch grading from the head state's participation
+                # flags (validator_monitor.rs process_valid_state); the
+                # monitor dedups by epoch internally
+                self.validator_monitor.evaluate_epoch(
+                    self.head_state, self.preset
+                )
         return head
 
     def head(self):

@@ -567,6 +567,19 @@ class LightClientStore:
             raise LightClientError("update signed in the future")
         if has_finality and attested_slot < finalized_slot:
             raise LightClientError("attested before finalized")
+        if not has_finality:
+            # spec validate: a non-finality update must carry the EMPTY
+            # finalized header — the sync aggregate signs only the
+            # attested header, so an unproven non-empty finalized_header
+            # would be attacker-chosen
+            empty = type(update.finalized_header).default()
+            if (
+                update.finalized_header.tree_hash_root()
+                != empty.tree_hash_root()
+            ):
+                raise LightClientError(
+                    "non-finality update carries a finalized header"
+                )
         store_period = self._period_of(int(self.finalized_header.slot))
         sig_period = self._period_of(sig_slot)
         attested_period = self._period_of(attested_slot)
@@ -620,7 +633,14 @@ class LightClientStore:
                 raise LightClientError(
                     "cannot install next committee from another period"
                 )
-            self.next_sync_committee = update.next_sync_committee
+            # only a committee-carrying update may install: a zeroed
+            # default committee would flip the None "unknown" sentinel and
+            # wedge the store at the period boundary (the spec's
+            # is_next_sync_committee_known compares against SyncCommittee()
+            # so a zeroed install stays "unknown" there; with a None
+            # sentinel the guard must live here)
+            if is_sync_committee_update(update):
+                self.next_sync_committee = update.next_sync_committee
         elif finalized_period == store_period + 1:
             self.current_sync_committee = self.next_sync_committee
             self.next_sync_committee = (

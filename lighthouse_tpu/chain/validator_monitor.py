@@ -1,7 +1,9 @@
 """Validator monitor (reference beacon_chain/src/validator_monitor.rs,
 1,690 LoC): per-registered-validator observability — block proposals,
-attestation inclusions and delays, missed duties — surfaced as metrics
-and queryable stats. Plus the block-times cache
+attestation inclusions and delays, per-epoch participation summaries
+(source/target/head hit or MISS, from the state's own participation
+flags), sync-committee signatures, exits and slashings — surfaced as
+metrics and queryable stats. Plus the block-times cache
 (block_times_cache.rs): per-block observed→imported latency."""
 
 from __future__ import annotations
@@ -12,12 +14,44 @@ from ..utils.metrics import REGISTRY
 
 
 @dataclass
+class EpochSummary:
+    """Per-epoch rollup for one monitored validator (validator_monitor.rs
+    EpochSummary): what it did, and what the chain ended up recording."""
+
+    epoch: int
+    attestations_seen: int = 0
+    attestation_min_delay: int | None = None
+    source_hit: bool | None = None  # None until the epoch is evaluated
+    target_hit: bool | None = None
+    head_hit: bool | None = None
+    sync_signatures: int = 0
+    blocks_proposed: int = 0
+    exits_observed: int = 0
+    slashings_observed: int = 0
+
+
+_SUMMARY_RETENTION = 8  # epochs of history per validator
+
+
+@dataclass
 class MonitoredValidator:
     index: int
     blocks_proposed: int = 0
     attestations_seen: int = 0
     attestation_min_delay_slots: dict[int, int] = field(default_factory=dict)
     last_attestation_slot: int | None = None
+    sync_signatures: int = 0
+    last_sync_signature_slot: int | None = None
+    summaries: dict[int, EpochSummary] = field(default_factory=dict)
+
+    def summary(self, epoch: int) -> EpochSummary:
+        s = self.summaries.get(epoch)
+        if s is None:
+            s = self.summaries[epoch] = EpochSummary(epoch)
+            # bounded history
+            for old in sorted(self.summaries)[: -_SUMMARY_RETENTION]:
+                del self.summaries[old]
+        return s
 
 
 @dataclass
@@ -42,6 +76,7 @@ class ValidatorMonitor:
         self.auto_register = auto_register
         self.validators: dict[int, MonitoredValidator] = {}
         self.block_times: dict[bytes, BlockTimes] = {}
+        self._last_evaluated_epoch: int | None = None
         self._proposals = REGISTRY.counter(
             "validator_monitor_blocks_proposed_total",
             "Blocks proposed by monitored validators",
@@ -54,6 +89,22 @@ class ValidatorMonitor:
             "validator_monitor_attestation_inclusion_delay_slots",
             "Slots between attestation slot and block inclusion",
             buckets=(1, 2, 3, 4, 8, 16, 32),
+        )
+        self._target_misses = REGISTRY.counter(
+            "validator_monitor_prev_epoch_target_misses_total",
+            "Monitored validators that missed the target in an epoch",
+        )
+        self._head_misses = REGISTRY.counter(
+            "validator_monitor_prev_epoch_head_misses_total",
+            "Monitored validators that missed the head in an epoch",
+        )
+        self._sync_signatures = REGISTRY.counter(
+            "validator_monitor_sync_committee_messages_total",
+            "Sync-committee messages by monitored validators",
+        )
+        self._slashed = REGISTRY.counter(
+            "validator_monitor_slashings_total",
+            "Slashings naming monitored validators",
         )
 
     def register_validator(self, index: int) -> None:
@@ -107,6 +158,89 @@ class ValidatorMonitor:
                 v.last_attestation_slot = slot
                 self._attestations.inc()
 
+    def on_sync_committee_message(self, validator_index: int, slot: int) -> None:
+        v = self._get(validator_index)
+        if v is not None:
+            v.sync_signatures += 1
+            v.last_sync_signature_slot = slot
+            self._sync_signatures.inc()
+
+    def on_exit_observed(self, validator_index: int, epoch: int) -> None:
+        v = self._get(validator_index)
+        if v is not None:
+            v.summary(epoch).exits_observed += 1
+
+    def on_slashing_observed(self, validator_indices, epoch: int) -> None:
+        for idx in validator_indices:
+            v = self._get(idx)
+            if v is not None:
+                v.summary(epoch).slashings_observed += 1
+                self._slashed.inc()
+
+    # -- per-epoch evaluation (validator_monitor.rs process_valid_state) ----
+
+    def evaluate_epoch(self, state, preset) -> None:
+        """At an epoch boundary, grade every monitored validator's
+        PREVIOUS epoch from the state's own participation flags: did the
+        chain record its source/target/head votes? Misses become counters
+        a dashboard can alert on — the reference's core monitoring loop."""
+        if not hasattr(state, "previous_epoch_participation"):
+            return  # phase0: pending-attestation grading not surfaced
+        from ..state_transition.participation import (
+            TIMELY_HEAD_FLAG_INDEX,
+            TIMELY_SOURCE_FLAG_INDEX,
+            TIMELY_TARGET_FLAG_INDEX,
+            has_flag,
+        )
+        from ..types import compute_epoch_at_slot, is_active_validator
+
+        current_epoch = compute_epoch_at_slot(state.slot, preset)
+        if current_epoch == 0:
+            return  # no completed epoch to grade yet
+        prev_epoch = current_epoch - 1
+        # RE-grade on every head change while the epoch is still "previous"
+        # — attestations for E-1 may land up to a full epoch late (delay
+        # 2+ crosses the boundary), so summaries stay live until the epoch
+        # retires. Miss COUNTERS bump only at retirement, from the final
+        # summary, so late inclusions cannot overstate misses.
+        if (
+            self._last_evaluated_epoch is not None
+            and prev_epoch > self._last_evaluated_epoch
+        ):
+            self._count_retired_epoch(self._last_evaluated_epoch)
+        self._last_evaluated_epoch = prev_epoch
+        part = state.previous_epoch_participation
+        for idx, v in self.validators.items():
+            if idx >= len(state.validators):
+                continue
+            val = state.validators[idx]
+            if not is_active_validator(val, prev_epoch):
+                continue
+            flags = part[idx]
+            s = v.summary(prev_epoch)
+            s.source_hit = bool(has_flag(flags, TIMELY_SOURCE_FLAG_INDEX))
+            s.target_hit = bool(has_flag(flags, TIMELY_TARGET_FLAG_INDEX))
+            s.head_hit = bool(has_flag(flags, TIMELY_HEAD_FLAG_INDEX))
+            s.attestations_seen = v.attestations_seen
+            delays = [
+                d
+                for sl, d in v.attestation_min_delay_slots.items()
+                if prev_epoch * preset.slots_per_epoch
+                <= sl
+                < (prev_epoch + 1) * preset.slots_per_epoch
+            ]
+            s.attestation_min_delay = min(delays) if delays else None
+
+    def _count_retired_epoch(self, epoch: int) -> None:
+        for v in self.validators.values():
+            s = v.summaries.get(epoch)
+            if s is None:
+                continue
+            if s.target_hit is False:
+                self._target_misses.inc()
+            if s.head_hit is False:
+                self._head_misses.inc()
+
     # -- queries (the /lighthouse/ui/validator-metrics seat) ----------------
 
     def stats(self, index: int) -> dict | None:
@@ -114,6 +248,18 @@ class ValidatorMonitor:
         if v is None:
             return None
         delays = v.attestation_min_delay_slots.values()
+        recent = [
+            {
+                "epoch": s.epoch,
+                "source_hit": s.source_hit,
+                "target_hit": s.target_hit,
+                "head_hit": s.head_hit,
+                "attestation_min_delay": s.attestation_min_delay,
+                "exits_observed": s.exits_observed,
+                "slashings_observed": s.slashings_observed,
+            }
+            for _, s in sorted(v.summaries.items())
+        ]
         return {
             "index": v.index,
             "blocks_proposed": v.blocks_proposed,
@@ -123,4 +269,5 @@ class ValidatorMonitor:
                 sum(delays) / len(delays) if delays else None
             ),
             "last_attestation_slot": v.last_attestation_slot,
+            "epoch_summaries": recent,
         }

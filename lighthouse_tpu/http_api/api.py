@@ -898,6 +898,8 @@ class BeaconApi:
                 None,
             )
         else:
+            if not validator_id.isdigit():  # rejects negatives + garbage
+                raise ApiError(400, f"bad validator id {validator_id!r}")
             index = int(validator_id)
         if index is None or index >= len(s.validators):
             raise ApiError(404, f"unknown validator {validator_id}")
@@ -996,6 +998,19 @@ class BeaconApi:
                 }
             )
         return {"data": {"index": str(index), "epochs": epochs}}
+
+    def lighthouse_validator_metrics(self, indices: list[int]) -> dict:
+        """POST /lighthouse/ui/validator_metrics (http_api lib.rs:2902):
+        per-validator monitor stats incl. epoch summaries."""
+        monitor = self.chain.validator_monitor
+        if monitor is None:
+            raise ApiError(400, "validator monitor not enabled")
+        out = {}
+        for i in indices:
+            s = monitor.stats(int(i))
+            if s is not None:
+                out[str(i)] = s
+        return {"data": {"validators": out}}
 
     def lighthouse_database_info(self) -> dict:
         store = self.chain.store

@@ -283,6 +283,12 @@ class BeaconApiServer:
                         ),
                     ),
                     (
+                        r"^/lighthouse/ui/validator_metrics$",
+                        lambda m: api.lighthouse_validator_metrics(
+                            (self._body() or {}).get("indices", [])
+                        ),
+                    ),
+                    (
                         r"^/eth/v1/beacon/pool/attestations$",
                         lambda m: api.post_pool_attestations(self._body()),
                     ),

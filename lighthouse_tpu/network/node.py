@@ -349,6 +349,15 @@ class NetworkNode:
             # a proven equivocation also strips the equivocators'
             # fork-choice weight immediately (spec on_attester_slashing)
             self.chain.fork_choice.on_attester_slashing(s)
+            if self.chain.validator_monitor is not None:
+                common = set(s.attestation_1.attesting_indices) & set(
+                    s.attestation_2.attesting_indices
+                )
+                self.chain.validator_monitor.on_slashing_observed(
+                    [int(i) for i in common],
+                    int(self.chain.current_slot)
+                    // self.chain.preset.slots_per_epoch,
+                )
 
         self._handle_op_gossip(
             slashing,
@@ -358,11 +367,18 @@ class NetworkNode:
         )
 
     def _on_gossip_voluntary_exit(self, signed_exit, source: str) -> None:
+        def accept(e):
+            self.op_pool.insert_voluntary_exit(e)
+            if self.chain.validator_monitor is not None:
+                self.chain.validator_monitor.on_exit_observed(
+                    int(e.message.validator_index), int(e.message.epoch)
+                )
+
         self._handle_op_gossip(
             signed_exit,
             source,
             self._validate_voluntary_exit,
-            self.op_pool.insert_voluntary_exit,
+            accept,
         )
 
     def _validate_proposer_slashing(self, slashing) -> None:
@@ -545,6 +561,10 @@ class NetworkNode:
         )
         for v in verified:
             self.sync_message_pool.insert(v)
+            if self.chain.validator_monitor is not None:
+                self.chain.validator_monitor.on_sync_committee_message(
+                    int(v.message.validator_index), int(v.message.slot)
+                )
         for msg, reason in rejected:
             if "signature" in reason:
                 self.penalize(sources.get(id(msg), ""))

@@ -207,13 +207,73 @@ def mini_tree(tmp_path_factory):
         },
     )
 
-    # ssz_static: round-trip + root for a fixed-size and a nested container
-    from lighthouse_tpu.types.containers import Checkpoint
+    # ssz_static with HAND-COMPUTED anchors (the b3a69f1 ssz_generic
+    # approach): serialized bytes written by concatenation per the SSZ
+    # spec and roots derived with raw hashlib merkle arithmetic — fully
+    # independent of this repo's encoder/merkleizer, so a bug there
+    # cannot self-confirm.
+    import hashlib as _hl
 
-    cp = Checkpoint(epoch=7, root=b"\x0c" * 32)
+    def _H(a, b):
+        return _hl.sha256(a + b).digest()
+
+    def _chunk_u64(v):
+        return v.to_bytes(8, "little") + bytes(24)
+
+    # Checkpoint {epoch: uint64, root: Bytes32}: 2 chunks, one hash
+    cp_epoch, cp_root = 7, b"\x0c" * 32
+    cp_ser = cp_epoch.to_bytes(8, "little") + cp_root
+    cp_hash = _H(_chunk_u64(cp_epoch), cp_root)
     case = base / "ssz_static" / "Checkpoint" / "ssz_random" / "case_0"
-    _write(case, "serialized.ssz_snappy", cp.as_ssz_bytes())
-    _write_yaml(case, "roots.yaml", {"root": "0x" + cp.tree_hash_root().hex()})
+    _write(case, "serialized.ssz_snappy", cp_ser)
+    _write_yaml(case, "roots.yaml", {"root": "0x" + cp_hash.hex()})
+
+    # Fork {previous: Bytes4, current: Bytes4, epoch: uint64}: 3 chunks
+    # padded to 4 leaves
+    fk_prev, fk_cur, fk_epoch = b"\x01\x02\x03\x04", b"\x05\x06\x07\x08", 9
+    fk_ser = fk_prev + fk_cur + fk_epoch.to_bytes(8, "little")
+    fk_hash = _H(
+        _H(fk_prev + bytes(28), fk_cur + bytes(28)),
+        _H(_chunk_u64(fk_epoch), bytes(32)),
+    )
+    case = base / "ssz_static" / "Fork" / "ssz_random" / "case_0"
+    _write(case, "serialized.ssz_snappy", fk_ser)
+    _write_yaml(case, "roots.yaml", {"root": "0x" + fk_hash.hex()})
+
+    # AttestationData {slot, index, beacon_block_root, source, target}:
+    # 5 leaves (two of them Checkpoint roots) padded to 8
+    ad_slot, ad_index = 3, 1
+    ad_bbr = b"\x0b" * 32
+    src = (2, b"\x0d" * 32)
+    tgt = (3, b"\x0e" * 32)
+    ad_ser = (
+        ad_slot.to_bytes(8, "little")
+        + ad_index.to_bytes(8, "little")
+        + ad_bbr
+        + src[0].to_bytes(8, "little")
+        + src[1]
+        + tgt[0].to_bytes(8, "little")
+        + tgt[1]
+    )
+    leaves = [
+        _chunk_u64(ad_slot),
+        _chunk_u64(ad_index),
+        ad_bbr,
+        _H(_chunk_u64(src[0]), src[1]),
+        _H(_chunk_u64(tgt[0]), tgt[1]),
+        bytes(32),
+        bytes(32),
+        bytes(32),
+    ]
+    l2 = [_H(leaves[i], leaves[i + 1]) for i in range(0, 8, 2)]
+    ad_hash = _H(_H(l2[0], l2[1]), _H(l2[2], l2[3]))
+    case = base / "ssz_static" / "AttestationData" / "ssz_random" / "case_0"
+    _write(case, "serialized.ssz_snappy", ad_ser)
+    _write_yaml(case, "roots.yaml", {"root": "0x" + ad_hash.hex()})
+
+    # BeaconState stays self-referential (plumbing coverage for the big
+    # variable-size container; its SEMANTIC anchoring comes from the
+    # hand-computed small containers above feeding the same merkleizer)
     case = base / "ssz_static" / "BeaconState" / "ssz_random" / "case_0"
     _write(case, "serialized.ssz_snappy", h.state.as_ssz_bytes())
     _write_yaml(
@@ -816,10 +876,10 @@ def test_mini_tree_state_cases(mini_tree):
     failures = [r for r in results if not r.ok]
     assert not failures, failures
     # slots, 2x blocks, exit, epoch, 3x genesis validity, genesis init,
-    # altair fork, shuffling, 2x ssz_static, fork_choice, transition,
-    # 2x rewards, light-client merkle proof + update_ranking + sync,
-    # random, 3x execution_payload
-    assert len(results) == 24
+    # altair fork, shuffling, 4x ssz_static (3 hand-anchored + state),
+    # fork_choice, transition, 2x rewards, light-client merkle proof +
+    # update_ranking + sync, random, 3x execution_payload
+    assert len(results) == 26
 
 
 def test_mini_tree_bls_cases_on_jax_backend(mini_tree):

@@ -77,6 +77,7 @@ class ValidatorMonitor:
         self.validators: dict[int, MonitoredValidator] = {}
         self.block_times: dict[bytes, BlockTimes] = {}
         self._last_evaluated_epoch: int | None = None
+        self._retired_through: int | None = None
         self._proposals = REGISTRY.counter(
             "validator_monitor_blocks_proposed_total",
             "Blocks proposed by monitored validators",
@@ -208,7 +209,16 @@ class ValidatorMonitor:
             and prev_epoch > self._last_evaluated_epoch
         ):
             self._count_retired_epoch(self._last_evaluated_epoch)
-        self._last_evaluated_epoch = prev_epoch
+        # a reorg can move the head to an EARLIER epoch; never regress the
+        # watermark or a later advance would retire (and count) the same
+        # epoch twice
+        if (
+            self._last_evaluated_epoch is None
+            or prev_epoch > self._last_evaluated_epoch
+        ):
+            self._last_evaluated_epoch = prev_epoch
+        elif prev_epoch < self._last_evaluated_epoch:
+            return
         part = state.previous_epoch_participation
         for idx, v in self.validators.items():
             if idx >= len(state.validators):
@@ -221,7 +231,6 @@ class ValidatorMonitor:
             s.source_hit = bool(has_flag(flags, TIMELY_SOURCE_FLAG_INDEX))
             s.target_hit = bool(has_flag(flags, TIMELY_TARGET_FLAG_INDEX))
             s.head_hit = bool(has_flag(flags, TIMELY_HEAD_FLAG_INDEX))
-            s.attestations_seen = v.attestations_seen
             delays = [
                 d
                 for sl, d in v.attestation_min_delay_slots.items()
@@ -229,9 +238,25 @@ class ValidatorMonitor:
                 <= sl
                 < (prev_epoch + 1) * preset.slots_per_epoch
             ]
+            # per-epoch figures, not lifetime counters: distinct included
+            # attestation slots and the best delay within THIS epoch
+            s.attestations_seen = len(delays)
             s.attestation_min_delay = min(delays) if delays else None
+            # prune inclusion-delay entries past the retention window so
+            # per-head-change grading stays O(window), not O(uptime)
+            horizon = (
+                max(prev_epoch - _SUMMARY_RETENTION, 0)
+                * preset.slots_per_epoch
+            )
+            for sl in [
+                sl for sl in v.attestation_min_delay_slots if sl < horizon
+            ]:
+                del v.attestation_min_delay_slots[sl]
 
     def _count_retired_epoch(self, epoch: int) -> None:
+        if self._retired_through is not None and epoch <= self._retired_through:
+            return
+        self._retired_through = epoch
         for v in self.validators.values():
             s = v.summaries.get(epoch)
             if s is None:

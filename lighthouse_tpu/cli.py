@@ -15,8 +15,13 @@ import time
 
 def _spec_preset(args):
     from .types import ChainSpec, MAINNET, MINIMAL
+    from .types.presets import GNOSIS
 
-    preset = MINIMAL if args.preset == "minimal" else MAINNET
+    preset = {"minimal": MINIMAL, "mainnet": MAINNET, "gnosis": GNOSIS}[
+        args.preset
+    ]
+    if args.network == "gnosis" and args.preset != "gnosis":
+        preset = GNOSIS  # the network pins its own compile-time preset
     if args.network == "interop":
         spec = ChainSpec.interop(
             altair_fork_epoch=args.altair_fork_epoch
@@ -29,9 +34,9 @@ def _spec_preset(args):
 def _add_network_args(p):
     p.add_argument("--network", default="interop",
                    choices=["interop", "minimal", "mainnet", "sepolia",
-                            "prater", "goerli"])
+                            "prater", "goerli", "gnosis"])
     p.add_argument("--preset", default="minimal",
-                   choices=["minimal", "mainnet"])
+                   choices=["minimal", "mainnet", "gnosis"])
     p.add_argument("--altair-fork-epoch", type=int, default=None)
     p.add_argument("--log-level", default="info",
                    choices=["trace", "debug", "info", "warn", "error"])

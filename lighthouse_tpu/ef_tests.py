@@ -256,9 +256,12 @@ def _run_epoch_case(case_dir, handler, config, fork) -> CaseResult:
     pre = state_cls.from_ssz_bytes(_load(case_dir, "pre.ssz_snappy"))
     post_raw = _load(case_dir, "post.ssz_snappy")
     try:
-        # the repo runs the FULL epoch transition (sub-transition isolation
-        # is a test-granularity nicety, not a consensus behavior)
-        process_epoch(pre, preset, spec)
+        # the official vectors' post-states reflect ONLY the named
+        # sub-transition (epoch_processing.rs EpochTransition impls), so
+        # run exactly that step, not the full transition
+        from .state_transition.per_epoch import run_epoch_sub_transition
+
+        run_epoch_sub_transition(pre, handler, preset, spec)
         applied = True
     except (BlockProcessingError, ValueError) as e:
         applied, error = False, str(e)

@@ -1007,9 +1007,13 @@ class BeaconApi:
             raise ApiError(400, "validator monitor not enabled")
         out = {}
         for i in indices:
-            s = monitor.stats(int(i))
+            try:
+                idx = int(i)
+            except (TypeError, ValueError):
+                raise ApiError(400, f"bad validator index {i!r}") from None
+            s = monitor.stats(idx)
             if s is not None:
-                out[str(i)] = s
+                out[str(idx)] = s
         return {"data": {"validators": out}}
 
     def lighthouse_database_info(self) -> dict:

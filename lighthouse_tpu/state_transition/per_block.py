@@ -244,11 +244,7 @@ def slash_validator(
     slashings = list(state.slashings)
     slashings[epoch % preset.epochs_per_slashings_vector] += v.effective_balance
     state.slashings = tuple(slashings)
-    quotient = (
-        spec.min_slashing_penalty_quotient
-        if state.fork_name == "phase0"
-        else spec.min_slashing_penalty_quotient_altair
-    )
+    quotient = spec.min_slashing_penalty_quotient_for(state.fork_name)
     decrease_balance(state, index, v.effective_balance // quotient)
 
     proposer_index = (

@@ -88,42 +88,11 @@ def compute_unrealized_checkpoints(state, preset: Preset, spec):
         state.finalized_checkpoint,
     )
     try:
-        previous_epoch = _previous_epoch(state, preset)
-        total_balance = _total_active_balance(state, preset, spec)
-        if state.fork_name == "phase0":
-            cache_map: dict = {}
-            prev_target = _attesting_indices(
-                state,
-                _matching_target_attestations(state, previous_epoch, preset),
-                preset,
-                spec,
-                cache_map,
-            )
-            # a state AT its epoch-start slot has no current-epoch block
-            # root yet (and necessarily no current-epoch attestations:
-            # inclusion delay >= 1)
-            try:
-                cur_matching = _matching_target_attestations(
-                    state, current_epoch, preset
-                )
-            except ValueError:
-                cur_matching = []
-            cur_target = _attesting_indices(
-                state, cur_matching, preset, spec, cache_map
-            )
-        else:
-            prev_target = _unslashed_participating_indices(
-                state, TIMELY_TARGET_FLAG_INDEX, previous_epoch, preset
-            )
-            cur_target = _unslashed_participating_indices(
-                state, TIMELY_TARGET_FLAG_INDEX, current_epoch, preset
-            )
+        total_balance, prev_bal, cur_bal = _justification_target_balances(
+            state, preset, spec
+        )
         _weigh_justification_and_finalization(
-            state,
-            total_balance,
-            get_total_balance(state, prev_target, spec),
-            get_total_balance(state, cur_target, spec),
-            preset,
+            state, total_balance, prev_bal, cur_bal, preset
         )
         ujc = (
             state.current_justified_checkpoint.epoch,
@@ -141,6 +110,121 @@ def compute_unrealized_checkpoints(state, preset: Preset, spec):
             state.justification_bits,
             state.finalized_checkpoint,
         ) = saved
+
+
+def _justification_target_balances(state, preset: Preset, spec):
+    """(total_active, prev_target, cur_target) balances feeding
+    weigh_justification_and_finalization — the ONE implementation behind
+    the full transitions, the isolated EF sub-transition, and the
+    fork-choice unrealized-checkpoint computation."""
+    current_epoch = _current_epoch(state, preset)
+    total = _total_active_balance(state, preset, spec)
+    if state.fork_name == "phase0":
+        cache_map: dict = {}
+        prev = _attesting_indices(
+            state,
+            _matching_target_attestations(
+                state, _previous_epoch(state, preset), preset
+            ),
+            preset,
+            spec,
+            cache_map,
+        )
+        try:
+            cur_matching = _matching_target_attestations(
+                state, current_epoch, preset
+            )
+        except ValueError:
+            # a state AT its epoch-start slot has no current-epoch block
+            # root yet (and necessarily no current-epoch attestations)
+            cur_matching = []
+        cur = _attesting_indices(state, cur_matching, preset, spec, cache_map)
+    else:
+        prev = _unslashed_participating_indices(
+            state,
+            TIMELY_TARGET_FLAG_INDEX,
+            _previous_epoch(state, preset),
+            preset,
+        )
+        cur = _unslashed_participating_indices(
+            state, TIMELY_TARGET_FLAG_INDEX, current_epoch, preset
+        )
+    return (
+        total,
+        get_total_balance(state, prev, spec),
+        get_total_balance(state, cur, spec),
+    )
+
+
+def _rotate_participation(state) -> None:
+    """End-of-epoch participation rotation, both flavors."""
+    if state.fork_name == "phase0":
+        state.previous_epoch_attestations = state.current_epoch_attestations
+        state.current_epoch_attestations = ()
+    else:
+        state.previous_epoch_participation = state.current_epoch_participation
+        state.current_epoch_participation = tuple(0 for _ in state.validators)
+
+
+def run_epoch_sub_transition(state, handler: str, preset: Preset, spec):
+    """Run ONE epoch sub-transition by its EF-vector handler name
+    (testing/ef_tests/src/cases/epoch_processing.rs maps the same names
+    to the same isolated spec functions). The official epoch_processing
+    vectors' post-states reflect only the named step, so the runner must
+    not execute the full transition."""
+    phase0 = state.fork_name == "phase0"
+    current_epoch = _current_epoch(state, preset)
+    if handler == "justification_and_finalization":
+        if current_epoch <= GENESIS_EPOCH + 1:
+            return
+        total, prev_bal, cur_bal = _justification_target_balances(
+            state, preset, spec
+        )
+        _weigh_justification_and_finalization(
+            state, total, prev_bal, cur_bal, preset
+        )
+    elif handler == "inactivity_updates":
+        if not phase0 and current_epoch > GENESIS_EPOCH:
+            _process_inactivity_updates(state, preset, spec)
+    elif handler == "rewards_and_penalties":
+        if current_epoch <= GENESIS_EPOCH:
+            return
+        total = _total_active_balance(state, preset, spec)
+        if phase0:
+            rewards, penalties = _attestation_deltas(
+                state, preset, spec, {}, total
+            )
+        else:
+            rewards, penalties = _flag_deltas(state, preset, spec, total)
+        apply_balance_deltas(state, rewards, penalties)
+    elif handler == "registry_updates":
+        _process_registry_updates(state, preset, spec)
+    elif handler == "slashings":
+        _process_slashings(
+            state,
+            preset,
+            spec,
+            spec.proportional_slashing_multiplier_for(state.fork_name),
+        )
+    elif handler == "eth1_data_reset":
+        _process_eth1_data_reset(state, preset)
+    elif handler == "effective_balance_updates":
+        _process_effective_balance_updates(state, spec)
+    elif handler == "slashings_reset":
+        _process_slashings_reset(state, preset)
+    elif handler == "randao_mixes_reset":
+        _process_randao_mixes_reset(state, preset)
+    elif handler in ("historical_roots_update", "historical_summaries_update"):
+        _process_historical_roots_update(state, preset)
+    elif handler in (
+        "participation_record_updates",
+        "participation_flag_updates",
+    ):
+        _rotate_participation(state)
+    elif handler == "sync_committee_updates":
+        _process_sync_committee_updates(state, preset, spec)
+    else:
+        raise ValueError(f"unknown epoch sub-transition {handler!r}")
 
 
 # ===========================================================================
@@ -411,26 +495,11 @@ def _process_epoch_base(state, preset, spec):
 
     # 1. justification & finalization
     if current_epoch > GENESIS_EPOCH + 1:
-        prev_target = _attesting_indices(
-            state,
-            _matching_target_attestations(state, previous_epoch, preset),
-            preset,
-            spec,
-            cache_map,
-        )
-        cur_target = _attesting_indices(
-            state,
-            _matching_target_attestations(state, current_epoch, preset),
-            preset,
-            spec,
-            cache_map,
+        _, prev_bal, cur_bal = _justification_target_balances(
+            state, preset, spec
         )
         _weigh_justification_and_finalization(
-            state,
-            total_balance,
-            get_total_balance(state, prev_target, spec),
-            get_total_balance(state, cur_target, spec),
-            preset,
+            state, total_balance, prev_bal, cur_bal, preset
         )
 
     # 2. rewards & penalties
@@ -448,9 +517,7 @@ def _process_epoch_base(state, preset, spec):
     _process_slashings_reset(state, preset)
     _process_randao_mixes_reset(state, preset)
     _process_historical_roots_update(state, preset)
-    # participation record rotation
-    state.previous_epoch_attestations = state.current_epoch_attestations
-    state.current_epoch_attestations = ()
+    _rotate_participation(state)
 
 
 def attestation_component_deltas(state, preset, spec, cache_map, total_balance):
@@ -577,18 +644,11 @@ def _process_epoch_altair(state, preset, spec):
 
     # 1. justification & finalization from participation flags
     if current_epoch > GENESIS_EPOCH + 1:
-        prev_target = _unslashed_participating_indices(
-            state, TIMELY_TARGET_FLAG_INDEX, previous_epoch, preset
-        )
-        cur_target = _unslashed_participating_indices(
-            state, TIMELY_TARGET_FLAG_INDEX, current_epoch, preset
+        _, prev_bal, cur_bal = _justification_target_balances(
+            state, preset, spec
         )
         _weigh_justification_and_finalization(
-            state,
-            total_balance,
-            get_total_balance(state, prev_target, spec),
-            get_total_balance(state, cur_target, spec),
-            preset,
+            state, total_balance, prev_bal, cur_bal, preset
         )
 
     # 2. inactivity scores
@@ -602,18 +662,17 @@ def _process_epoch_altair(state, preset, spec):
 
     _process_registry_updates(state, preset, spec)
     _process_slashings(
-        state, preset, spec, spec.proportional_slashing_multiplier_altair
+        state,
+        preset,
+        spec,
+        spec.proportional_slashing_multiplier_for(state.fork_name),
     )
     _process_eth1_data_reset(state, preset)
     _process_effective_balance_updates(state, spec)
     _process_slashings_reset(state, preset)
     _process_randao_mixes_reset(state, preset)
     _process_historical_roots_update(state, preset)
-    # participation flag rotation
-    state.previous_epoch_participation = state.current_epoch_participation
-    state.current_epoch_participation = tuple(
-        0 for _ in state.validators
-    )
+    _rotate_participation(state)
     _process_sync_committee_updates(state, preset, spec)
 
 
@@ -686,7 +745,7 @@ def flag_component_deltas(state, preset, spec, total_balance):
                 * state.inactivity_scores[i]
                 // (
                     spec.inactivity_score_bias
-                    * spec.inactivity_penalty_quotient_altair
+                    * spec.inactivity_penalty_quotient_for(state.fork_name)
                 )
             )
     out["inactivity"] = ([0] * n, penalties)

@@ -218,7 +218,10 @@ def process_epoch_altair_vec(state, preset: Preset, spec) -> None:
                 )
         # inactivity penalties read the updated scores
         miss_target = eligible & ~prev_target
-        denom = spec.inactivity_score_bias * spec.inactivity_penalty_quotient_altair
+        denom = (
+            spec.inactivity_score_bias
+            * spec.inactivity_penalty_quotient_for(state.fork_name)
+        )
         penalties[miss_target] += (
             cols.eff[miss_target] * scores[miss_target].astype(np.int64)
             // np.int64(denom)
@@ -237,7 +240,8 @@ def process_epoch_altair_vec(state, preset: Preset, spec) -> None:
     # penalty arithmetic runs in exact Python ints per hit
     slash_sum = sum(state.slashings)
     adjusted = min(
-        slash_sum * spec.proportional_slashing_multiplier_altair, total_balance
+        slash_sum * spec.proportional_slashing_multiplier_for(state.fork_name),
+        total_balance
     )
     hits = np.nonzero(
         cols.slashed

@@ -80,6 +80,10 @@ class ChainSpec:
     proportional_slashing_multiplier_altair: int = 2
     inactivity_score_bias: int = 4
     inactivity_score_recovery_rate: int = 16
+    # rewards & penalties (bellatrix overrides, chain_spec.rs:142-144)
+    inactivity_penalty_quotient_bellatrix: int = 2**24
+    min_slashing_penalty_quotient_bellatrix: int = 32
+    proportional_slashing_multiplier_bellatrix: int = 3
 
     # attestation aggregation
     target_aggregators_per_committee: int = 16
@@ -114,6 +118,27 @@ class ChainSpec:
         if self.altair_fork_epoch is not None and epoch >= self.altair_fork_epoch:
             return self.altair_fork_version
         return self.genesis_fork_version
+
+    # fork-sensitive penalty parameters (chain_spec.rs:273-295
+    # *_for_state helpers; keyed here by the state's fork name)
+
+    def proportional_slashing_multiplier_for(self, fork_name: str) -> int:
+        return {
+            "phase0": self.proportional_slashing_multiplier,
+            "altair": self.proportional_slashing_multiplier_altair,
+        }.get(fork_name, self.proportional_slashing_multiplier_bellatrix)
+
+    def inactivity_penalty_quotient_for(self, fork_name: str) -> int:
+        return {
+            "phase0": self.inactivity_penalty_quotient,
+            "altair": self.inactivity_penalty_quotient_altair,
+        }.get(fork_name, self.inactivity_penalty_quotient_bellatrix)
+
+    def min_slashing_penalty_quotient_for(self, fork_name: str) -> int:
+        return {
+            "phase0": self.min_slashing_penalty_quotient,
+            "altair": self.min_slashing_penalty_quotient_altair,
+        }.get(fork_name, self.min_slashing_penalty_quotient_bellatrix)
 
     def fork_name_at_epoch(self, epoch: int) -> str:
         if (
@@ -179,6 +204,35 @@ class ChainSpec:
         )
 
     @classmethod
+    def gnosis(cls) -> "ChainSpec":
+        """Gnosis chain (built_in_network_configs/gnosis): 5 s slots,
+        16-slot epochs (the GNOSIS preset), its own fork-version family
+        and churn limits."""
+        return cls(
+            config_name="gnosis",
+            genesis_fork_version=bytes.fromhex("00000064"),
+            altair_fork_version=bytes.fromhex("01000064"),
+            altair_fork_epoch=512,
+            bellatrix_fork_version=bytes.fromhex("02000064"),
+            bellatrix_fork_epoch=385536,
+            min_genesis_time=1638968400,
+            genesis_delay=6000,
+            min_genesis_active_validator_count=4096,
+            seconds_per_slot=5,
+            base_reward_factor=25,
+            churn_limit_quotient=4096,
+            min_per_epoch_churn_limit=4,
+            terminal_total_difficulty=(
+                8626000000000000000000058750000000000000000000
+            ),
+            deposit_chain_id=100,
+            deposit_network_id=100,
+            deposit_contract_address=bytes.fromhex(
+                "0b98057ea310f4d31f2a452b414647007d1645d9"
+            ),
+        )
+
+    @classmethod
     def network(cls, name: str) -> "ChainSpec":
         """Embedded per-network bundles (the eth2_network_config seat,
         common/eth2_network_config/src/lib.rs:33-52)."""
@@ -187,6 +241,7 @@ class ChainSpec:
             "sepolia": cls.sepolia,
             "prater": cls.prater,
             "goerli": cls.prater,
+            "gnosis": cls.gnosis,
             "minimal": cls.minimal,
             "interop": cls.interop,
         }

@@ -71,6 +71,29 @@ MAINNET = Preset(
     epochs_per_sync_committee_period=256,
 )
 
+GNOSIS = Preset(
+    # the reference's gnosis EthSpec (consensus/types/presets/gnosis/*):
+    # mainnet sizing with 16-slot epochs and 512-epoch sync periods
+    name="gnosis",
+    slots_per_epoch=16,
+    epochs_per_eth1_voting_period=64,
+    slots_per_historical_root=8192,
+    epochs_per_historical_vector=65536,
+    epochs_per_slashings_vector=8192,
+    historical_roots_limit=16_777_216,
+    validator_registry_limit=1_099_511_627_776,
+    max_committees_per_slot=64,
+    target_committee_size=128,
+    max_validators_per_committee=2048,
+    max_proposer_slashings=16,
+    max_attester_slashings=2,
+    max_attestations=128,
+    max_deposits=16,
+    max_voluntary_exits=16,
+    sync_committee_size=512,
+    epochs_per_sync_committee_period=512,
+)
+
 MINIMAL = Preset(
     name="minimal",
     slots_per_epoch=8,

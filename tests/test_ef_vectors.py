@@ -84,21 +84,28 @@ def mini_tree(tmp_path_factory):
     _write(case, "pre.ssz_snappy", young.as_ssz_bytes())
     _write(case, "voluntary_exit.ssz_snappy", exit_op.as_ssz_bytes())
 
-    # epoch_processing: full transition at an epoch boundary
-    case = (
-        base
-        / "epoch_processing"
-        / "justification_and_finalization"
-        / "pyspec_tests"
-        / "boundary"
+    # epoch_processing: ISOLATED sub-transitions (official vectors' post
+    # states reflect only the named step, epoch_processing.rs)
+    from lighthouse_tpu.state_transition.per_epoch import (
+        run_epoch_sub_transition,
     )
+
     boundary = process_slots(
         clone_state(h.state), SLOTS - 1, MINIMAL, h.spec
     )
-    _write(case, "pre.ssz_snappy", boundary.as_ssz_bytes())
-    post = clone_state(boundary)
-    process_epoch(post, MINIMAL, h.spec)
-    _write(case, "post.ssz_snappy", post.as_ssz_bytes())
+    for sub in (
+        "justification_and_finalization",
+        "rewards_and_penalties",
+        "registry_updates",
+        "effective_balance_updates",
+        "slashings_reset",
+        "randao_mixes_reset",
+    ):
+        case = base / "epoch_processing" / sub / "pyspec_tests" / "boundary"
+        _write(case, "pre.ssz_snappy", boundary.as_ssz_bytes())
+        post = clone_state(boundary)
+        run_epoch_sub_transition(post, sub, MINIMAL, h.spec)
+        _write(case, "post.ssz_snappy", post.as_ssz_bytes())
 
     # genesis/validity: around both thresholds (real semantic anchors --
     # expected values are forced by construction, not by running the
@@ -875,11 +882,12 @@ def test_mini_tree_state_cases(mini_tree):
     results = run_tree(mini_tree, configs=("minimal",))
     failures = [r for r in results if not r.ok]
     assert not failures, failures
-    # slots, 2x blocks, exit, epoch, 3x genesis validity, genesis init,
-    # altair fork, shuffling, 4x ssz_static (3 hand-anchored + state),
-    # fork_choice, transition, 2x rewards, light-client merkle proof +
-    # update_ranking + sync, random, 3x execution_payload
-    assert len(results) == 26
+    # slots, 2x blocks, exit, 6x epoch sub-transitions, 3x genesis
+    # validity, genesis init, altair fork, shuffling, 4x ssz_static
+    # (3 hand-anchored + state), fork_choice, transition, 2x rewards,
+    # light-client merkle proof + update_ranking + sync, random,
+    # 3x execution_payload
+    assert len(results) == 31
 
 
 def test_mini_tree_bls_cases_on_jax_backend(mini_tree):

@@ -147,3 +147,96 @@ def test_vec_guard_falls_back_cleanly():
     _process_epoch_altair(a, MINIMAL, spec)
     process_epoch(b, MINIMAL, spec)  # routes through guard -> oracle
     assert a.tree_hash_root() == b.tree_hash_root()
+
+
+def test_sub_transitions_compose_to_full_epoch():
+    """Running every EF epoch_processing sub-transition in spec order must
+    equal the full process_epoch — pins the sub-transition dispatch
+    (per_epoch.run_epoch_sub_transition) to the real transition."""
+    from lighthouse_tpu.state_transition.per_epoch import (
+        _process_epoch_altair,
+        run_epoch_sub_transition,
+    )
+
+    state, spec = _altair_state(3)
+    _scramble(state, 11, leak=False, spec=spec)
+    full = clone_state(state)
+    _process_epoch_altair(full, MINIMAL, spec)
+    step = clone_state(state)
+    for sub in (
+        "justification_and_finalization",
+        "inactivity_updates",
+        "rewards_and_penalties",
+        "registry_updates",
+        "slashings",
+        "eth1_data_reset",
+        "effective_balance_updates",
+        "slashings_reset",
+        "randao_mixes_reset",
+        "historical_roots_update",
+        "participation_flag_updates",
+        "sync_committee_updates",
+    ):
+        run_epoch_sub_transition(step, sub, MINIMAL, spec)
+    assert step.tree_hash_root() == full.tree_hash_root()
+
+
+def test_bellatrix_slashing_multiplier_is_3():
+    """chain_spec.rs:273-283 proportional_slashing_multiplier_for_state:
+    phase0=1, altair=2, bellatrix=3 — the bellatrix value was previously
+    collapsed onto altair's, understating correlated penalties."""
+    from lighthouse_tpu.types import ChainSpec
+
+    spec = ChainSpec.interop()
+    assert spec.proportional_slashing_multiplier_for("phase0") == 1
+    assert spec.proportional_slashing_multiplier_for("altair") == 2
+    assert spec.proportional_slashing_multiplier_for("bellatrix") == 3
+    assert spec.inactivity_penalty_quotient_for("bellatrix") == 2**24
+    assert spec.min_slashing_penalty_quotient_for("bellatrix") == 32
+
+    # end-to-end: a slashed validator at the half-vector point loses 3x
+    # the correlated fraction on a bellatrix state
+    from lighthouse_tpu.state_transition.per_epoch import (
+        run_epoch_sub_transition,
+    )
+    from lighthouse_tpu.types import types_for
+    from lighthouse_tpu.types.containers import state_class_for
+
+    t = types_for(MINIMAL)
+    for fork, mult in (("altair", 2), ("bellatrix", 3)):
+        state = state_class_for(t, fork).default()
+        n = 64
+        from lighthouse_tpu.types.containers import Validator
+
+        epoch = 5
+        state.slot = epoch * MINIMAL.slots_per_epoch
+        state.validators = tuple(
+            Validator(
+                pubkey=bytes(48),
+                withdrawal_credentials=bytes(32),
+                effective_balance=32 * 10**9,
+                slashed=(i == 0),
+                exit_epoch=FAR_FUTURE_EPOCH if i else epoch,
+                withdrawable_epoch=(
+                    FAR_FUTURE_EPOCH
+                    if i
+                    else epoch + MINIMAL.epochs_per_slashings_vector // 2
+                ),
+            )
+            for i in range(n)
+        )
+        state.balances = tuple(32 * 10**9 for _ in range(n))
+        slashings = list(state.slashings)
+        slashings[0] = 32 * 10**9  # the slashed validator's balance
+        state.slashings = tuple(slashings)
+        spec2 = ChainSpec.interop(altair_fork_epoch=0)
+        run_epoch_sub_transition(state, "slashings", MINIMAL, spec2)
+        total = (n - 1) * 32 * 10**9
+        incr = spec2.effective_balance_increment
+        expected_penalty = (
+            32 * 10**9 // incr
+            * min(32 * 10**9 * mult, total)
+            // total
+            * incr
+        )
+        assert state.balances[0] == 32 * 10**9 - expected_penalty, fork

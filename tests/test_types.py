@@ -150,3 +150,28 @@ class TestNetworkConfigs:
 
         with _pytest.raises(ValueError, match="unknown network"):
             ChainSpec.network("atlantis")
+
+
+class TestGnosisPreset:
+    def test_gnosis_network_and_preset(self):
+        """Gnosis chain bundle (built_in_network_configs/gnosis +
+        consensus/types/presets/gnosis): 5 s slots, 16-slot epochs,
+        512-epoch sync periods, its own fork-version family."""
+        from lighthouse_tpu.types import ChainSpec, types_for
+        from lighthouse_tpu.types.presets import GNOSIS
+
+        spec = ChainSpec.network("gnosis")
+        assert spec.seconds_per_slot == 5
+        assert spec.base_reward_factor == 25
+        assert spec.churn_limit_quotient == 4096
+        assert bytes(spec.genesis_fork_version) == bytes.fromhex("00000064")
+        assert spec.fork_name_at_epoch(0) == "phase0"
+        assert spec.fork_name_at_epoch(512) == "altair"
+        assert spec.fork_name_at_epoch(385536) == "bellatrix"
+
+        assert GNOSIS.slots_per_epoch == 16
+        assert GNOSIS.epochs_per_sync_committee_period == 512
+        assert GNOSIS.slots_per_historical_root == 8192
+        t = types_for(GNOSIS)
+        state = t.BeaconState.default()
+        assert len(list(state.block_roots)) == 8192

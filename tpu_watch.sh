@@ -1,21 +1,47 @@
 #!/bin/bash
-# Tunnel watcher: probe until the axon TPU tunnel is up, then immediately
-# warm the jit cache (staged, resumable) and run the bench. Logs to
-# tpu_watch.log; exits after one warm+bench cycle so the session can react.
+# Tunnel watcher: probe until the axon TPU tunnel is up; on recovery warm
+# the jit cache (staged, resumable across flaps) and run the bench. Keeps
+# looping until a platform=tpu bench artifact lands, then warms the bigger
+# 4096 bucket and re-benches at scale. Logs to tpu_watch.log.
 cd /root/repo
 LOG=tpu_watch.log
 echo "[watch] start $(date -u +%H:%M:%S)" >> "$LOG"
-while true; do
+
+probe() {
   timeout 45 python -c "import jax; assert jax.devices()[0].platform != 'cpu'" 2>/dev/null
-  if [ $? -eq 0 ]; then
-    echo "[watch] TUNNEL UP $(date -u +%H:%M:%S)" >> "$LOG"
-    break
+}
+
+bench_is_tpu() {
+  tail -1 "$1" 2>/dev/null | python3 -c "
+import json,sys
+try:
+    d=json.loads(sys.stdin.readline())
+    sys.exit(0 if d.get('platform')=='tpu' else 1)
+except Exception:
+    sys.exit(1)"
+}
+
+while true; do
+  if probe; then
+    echo "[watch] TUNNEL UP $(date -u +%H:%M:%S); warming 16,1024" >> "$LOG"
+    timeout 3600 python warm_tpu.py >> "$LOG" 2>&1
+    echo "[watch] warm rc=$? $(date -u +%H:%M:%S); benching n=1024" >> "$LOG"
+    timeout 1500 python bench.py > /tmp/bench_tpu_try.json 2>>"$LOG"
+    cat /tmp/bench_tpu_try.json >> "$LOG"
+    if bench_is_tpu /tmp/bench_tpu_try.json; then
+      echo "[watch] TPU ARTIFACT CAPTURED $(date -u +%H:%M:%S)" >> "$LOG"
+      echo "[watch] warming 4096 bucket" >> "$LOG"
+      WARM_SETS=16,1024,4096 timeout 5400 python warm_tpu.py >> "$LOG" 2>&1
+      echo "[watch] benching n=4096 distinct=128" >> "$LOG"
+      BENCH_SETS=4096 BENCH_DISTINCT=128 timeout 1500 python bench.py \
+        > /tmp/bench_tpu_4096.json 2>>"$LOG"
+      cat /tmp/bench_tpu_4096.json >> "$LOG"
+      echo "[watch] done $(date -u +%H:%M:%S)" >> "$LOG"
+      exit 0
+    fi
+    echo "[watch] no tpu artifact; re-probing" >> "$LOG"
+  else
+    echo "[watch] down $(date -u +%H:%M:%S)" >> "$LOG"
   fi
-  echo "[watch] down $(date -u +%H:%M:%S)" >> "$LOG"
   sleep 240
 done
-echo "[watch] warming..." >> "$LOG"
-timeout 3600 python warm_tpu.py >> "$LOG" 2>&1
-echo "[watch] warm rc=$? $(date -u +%H:%M:%S); benching..." >> "$LOG"
-timeout 1200 python bench.py >> "$LOG" 2>&1
-echo "[watch] bench rc=$? done $(date -u +%H:%M:%S)" >> "$LOG"

@@ -207,6 +207,16 @@ class NetworkNode:
     def penalize(self, peer: str, amount: int = GOSSIP_PENALTY) -> None:
         with self.pools_lock:
             self.peer_scores[peer] = self.peer_scores.get(peer, 0) + amount
+        # feed the wire-level behavioral scorer too, severity-mapped:
+        # full gossip penalties are P4 invalid-message events; mild -1
+        # nudges (RPC timeouts, empty responses) are only a small P7
+        # behaviour penalty — an honest-but-slow peer must not graylist
+        scorer = getattr(self.bus, "scorer", None)
+        if scorer is not None and peer:
+            if amount <= GOSSIP_PENALTY:
+                scorer.on_invalid(peer)
+            elif amount < 0:
+                scorer.on_behaviour_penalty(peer, 0.2)
 
     def is_banned(self, peer: str) -> bool:
         return self.peer_scores.get(peer, 0) <= BAN_THRESHOLD

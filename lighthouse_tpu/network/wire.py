@@ -559,6 +559,12 @@ class WireBus:
         self.req_burst = req_burst
         self.req_rate_per_s = req_rate_per_s
         self._seen: OrderedDict[bytes, bool] = OrderedDict()
+        # gossipsub behavioral scoring (gossipsub_scoring_parameters.rs):
+        # relayer-keyed; graylisted peers' gossip drops at the door and
+        # negative-score mesh peers are pruned during relay
+        from .peer_score import PeerScorer
+
+        self.scorer = PeerScorer()
         self._lock = threading.Lock()
         self._server = None
         # observability for mesh/limiter tests
@@ -789,6 +795,7 @@ class WireBus:
                     and len(mesh) < self.mesh_degree
                 ):
                     mesh.add(peer["peer_id"])
+                    self.scorer.on_graft(peer["peer_id"], topic)
                     graft_topics.append(topic)
         for topic in graft_topics:
             self._send_graft(peer["peer_id"], topic)
@@ -824,6 +831,7 @@ class WireBus:
             return conn
 
     def _drop_peer(self, peer_id: str) -> None:
+        self.scorer.forget(peer_id)
         with self._lock:
             self._peers.pop(peer_id, None)
             conn = self._conns.pop(peer_id, None)
@@ -877,11 +885,53 @@ class WireBus:
         )
         with self._lock:
             mesh = set(self._mesh.get(topic, ()))
+            # behavioral eviction: peers scored below the prune threshold
+            # leave the mesh (and get a PRUNE) before this relay
+            evict = {p for p in mesh if self.scorer.should_prune(p)}
+            if evict:
+                self._mesh[topic] = mesh - evict
+                mesh -= evict
+                for p in evict:
+                    self.scorer.on_prune(p, topic)
             subscribers = {
                 pid
                 for pid, info in self._peers.items()
                 if topic in info["topics"]
+                # gossip_threshold: stop relaying TO low-score peers
+                and self.scorer.score(pid) >= self.scorer.gossip_threshold
             }
+            # backfill the mesh after eviction (every other removal path
+            # re-grafts; eviction must not strand the mesh below degree)
+            backfill = []
+            if evict and len(mesh) < self.mesh_degree:
+                candidates = [
+                    pid
+                    for pid in subscribers
+                    if pid not in mesh
+                    and pid not in evict
+                    and pid not in self._pruned_by.get(topic, set())
+                ]
+                backfill = candidates[: self.mesh_degree - len(mesh)]
+                self._mesh[topic].update(backfill)
+                mesh.update(backfill)
+                for pid in backfill:
+                    self.scorer.on_graft(pid, topic)
+        for pid in backfill:
+            self._send_graft(pid, topic)
+        # symmetric PRUNE (outside the lock: network sends): the evicted
+        # peer must drop US from its mesh too or it keeps pushing to us
+        for p in evict:
+            conn = self._conn_for(p)
+            if conn is not None:
+                try:
+                    conn.send(
+                        FRAME_PRUNE,
+                        json.dumps(
+                            {"peer_id": self.peer_id, "topic": topic, "px": []}
+                        ).encode(),
+                    )
+                except OSError:
+                    pass
         subscribers.discard(exclude)
         # exclude FIRST: a mesh shrunk to exactly the upstream sender must
         # fall back to the other known subscribers, not relay to nobody
@@ -931,11 +981,22 @@ class WireBus:
                     mesh = self._mesh.setdefault(topic, set())
                     if msg["peer_id"] in mesh:
                         pass
+                    elif self.scorer.should_prune(msg["peer_id"]):
+                        # an evicted peer cannot graft straight back in:
+                        # behavioral eviction must outlast a re-GRAFT
+                        refuse = True
+                        self.scorer.on_behaviour_penalty(
+                            msg["peer_id"], 0.5
+                        )
                     elif len(mesh) < 2 * self.mesh_degree:
                         # accept grafts up to 2x degree (gossipsub D_high)
                         mesh.add(msg["peer_id"])
+                        self.scorer.on_graft(msg["peer_id"], topic)
                     else:
                         refuse = True
+                        # repeated grafts into a saturated mesh are the
+                        # gossipsub behaviour-penalty case (P7)
+                        self.scorer.on_behaviour_penalty(msg["peer_id"], 0.5)
             if refuse:
                 # full mesh: PRUNE so the grafter re-grafts elsewhere,
                 # carrying peer-exchange suggestions (gossipsub PX) so a
@@ -999,7 +1060,16 @@ class WireBus:
             (plen,) = struct.unpack_from(">H", body, pos)
             source = body[pos + 2 : pos + 2 + plen].decode()
             data = body[pos + 2 + plen :]
-            if not self._mark_seen(self._msg_id(topic, data)):
+            with self._lock:
+                if self.scorer.graylisted(source):
+                    self.stats["gossip_graylisted"] = (
+                        self.stats.get("gossip_graylisted", 0) + 1
+                    )
+                    return
+            first = self._mark_seen(self._msg_id(topic, data))
+            with self._lock:
+                self.scorer.on_deliver(source, topic, first)
+            if not first:
                 return
             handler = self._subs.get(topic)
             if handler is not None:

@@ -40,6 +40,9 @@ class MonitoredValidator:
     attestations_seen: int = 0
     attestation_min_delay_slots: dict[int, int] = field(default_factory=dict)
     last_attestation_slot: int | None = None
+    # bounded window of recently-gossiped attestation slots (liveness
+    # queries must see epoch E even after the validator attests E+1)
+    recent_attestation_slots: dict[int, None] = field(default_factory=dict)
     sync_signatures: int = 0
     last_sync_signature_slot: int | None = None
     summaries: dict[int, EpochSummary] = field(default_factory=dict)
@@ -157,6 +160,11 @@ class ValidatorMonitor:
             if v is not None:
                 v.attestations_seen += 1
                 v.last_attestation_slot = slot
+                v.recent_attestation_slots[slot] = None
+                while len(v.recent_attestation_slots) > 128:
+                    v.recent_attestation_slots.pop(
+                        next(iter(v.recent_attestation_slots))
+                    )
                 self._attestations.inc()
 
     def on_sync_committee_message(self, validator_index: int, slot: int) -> None:

@@ -1016,6 +1016,141 @@ class BeaconApi:
                 out[str(idx)] = s
         return {"data": {"validators": out}}
 
+    def lighthouse_health(self) -> dict:
+        """GET /lighthouse/health (lib.rs:2855): process liveness basics,
+        from the ONE getrusage reader (utils/monitoring.process_metrics)."""
+        from ..utils.monitoring import process_metrics
+
+        data = {k: str(v) for k, v in process_metrics().items()}
+        data["head_slot"] = str(self.chain.head_state.slot)
+        return {"data": data}
+
+    def lighthouse_syncing(self) -> dict:
+        """GET /lighthouse/syncing (lib.rs:2918): the node's sync state
+        with the lighthouse-native shape."""
+        body = self.get_syncing()["data"]
+        state = (
+            "Synced" if not body.get("is_syncing") else "SyncingFinalized"
+        )
+        return {"data": state}
+
+    def lighthouse_staking(self) -> dict:
+        """GET /lighthouse/staking (lib.rs:3127): 200 iff the node can
+        support staking (an eth1/deposit source is wired)."""
+        if self.node.eth1_service is None:
+            raise ApiError(
+                404, "staking unavailable: no eth1 endpoint configured"
+            )
+        return {"data": "staking ready"}
+
+    def lighthouse_eth1_syncing(self) -> dict:
+        """GET /lighthouse/eth1/syncing (lib.rs:3033)."""
+        svc = self.node.eth1_service
+        if svc is None:
+            raise ApiError(400, "no eth1 service")
+        head = svc.block_cache[-1] if svc.block_cache else None
+        return {
+            "data": {
+                "head_block_number": str(head.number) if head else None,
+                "head_block_timestamp": str(head.timestamp) if head else None,
+                # the service does not track the remote head, so the sync
+                # percentage is honestly UNKNOWN — never a fabricated 100
+                "eth1_node_sync_status_percentage": None,
+                "lighthouse_is_cached_and_ready": bool(head),
+            }
+        }
+
+    def lighthouse_eth1_block_cache(self) -> dict:
+        """GET /lighthouse/eth1/block_cache (lib.rs:3063)."""
+        svc = self.node.eth1_service
+        if svc is None:
+            raise ApiError(400, "no eth1 service")
+        return {
+            "data": [
+                {
+                    "number": str(b.number),
+                    "hash": hexs(b.hash),
+                    "timestamp": str(b.timestamp),
+                    "deposit_count": str(b.deposit_count),
+                }
+                for b in svc.block_cache
+            ]
+        }
+
+    def lighthouse_eth1_deposit_cache(self) -> dict:
+        """GET /lighthouse/eth1/deposit_cache (lib.rs:3082)."""
+        svc = self.node.eth1_service
+        if svc is None:
+            raise ApiError(400, "no eth1 service")
+        return {
+            "data": [
+                {
+                    "pubkey": hexs(d.pubkey),
+                    "amount": str(d.amount),
+                }
+                for d in svc._deposit_data
+            ]
+        }
+
+    def lighthouse_merge_readiness(self) -> dict:
+        """GET /lighthouse/merge_readiness (lib.rs:3240)."""
+        el = self.chain.execution_layer
+        if el is None:
+            return {
+                "data": {
+                    "type": "not_ready",
+                    "reason": "no execution endpoint configured",
+                }
+            }
+        return {"data": {"type": "ready"}}
+
+    def lighthouse_database_reconstruct(self) -> dict:
+        """POST /lighthouse/database/reconstruct (lib.rs:3155): fill any
+        missing restore-point states below the split from the chunked
+        columns (the reference's historic state reconstruction trigger)."""
+        from ..store.kv import Column
+
+        store = self.chain.store
+        before = len(store.kv.keys(Column.FREEZER_STATE))
+        store._store_restore_points(0, store.split_slot)
+        after = len(store.kv.keys(Column.FREEZER_STATE))
+        return {
+            "data": (
+                f"reconstruction complete: +{after - before} restore points"
+            )
+        }
+
+    def lighthouse_liveness(self, indices: list, epoch: int) -> dict:
+        """POST /lighthouse/liveness (lib.rs:2812): did these validators
+        show signs of life (gossip attestations seen) in `epoch`? Served
+        from the validator monitor's observation stream."""
+        monitor = self.chain.validator_monitor
+        spe = self.chain.preset.slots_per_epoch
+        out = []
+        for i in indices:
+            try:
+                idx = int(i)
+            except (TypeError, ValueError):
+                raise ApiError(400, f"bad validator index {i!r}") from None
+            live = False
+            if monitor is not None:
+                v = monitor.validators.get(idx)
+                if v is not None:
+                    lo, hi = epoch * spe, (epoch + 1) * spe
+                    # live = seen on gossip OR included on-chain in `epoch`
+                    # (recent_attestation_slots keeps a WINDOW of gossip
+                    # slots; a newer attestation must not erase epoch E)
+                    live = any(
+                        lo <= sl < hi for sl in v.recent_attestation_slots
+                    ) or any(
+                        lo <= sl < hi
+                        for sl in v.attestation_min_delay_slots
+                    )
+            out.append(
+                {"index": str(idx), "epoch": str(epoch), "is_live": live}
+            )
+        return {"data": out}
+
     def lighthouse_database_info(self) -> dict:
         store = self.chain.store
         return {

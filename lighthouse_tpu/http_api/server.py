@@ -13,6 +13,21 @@ from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from .api import ApiError, BeaconApi
 
 
+def _liveness_body(body) -> tuple:
+    """Validate the /lighthouse/liveness POST body: indices must be a
+    list, epoch a required integer — malformed requests are 400s."""
+    body = body or {}
+    indices = body.get("indices")
+    if not isinstance(indices, list):
+        raise ApiError(400, "indices must be a list")
+    epoch = body.get("epoch")
+    try:
+        epoch = int(epoch)
+    except (TypeError, ValueError):
+        raise ApiError(400, f"bad epoch {epoch!r}") from None
+    return indices, epoch
+
+
 class BeaconApiServer:
     def __init__(self, api: BeaconApi, host: str = "127.0.0.1", port: int = 0):
         self.api = api
@@ -255,6 +270,34 @@ class BeaconApiServer:
                         lambda m: api.lighthouse_database_info(),
                     ),
                     (
+                        r"^/lighthouse/health$",
+                        lambda m: api.lighthouse_health(),
+                    ),
+                    (
+                        r"^/lighthouse/syncing$",
+                        lambda m: api.lighthouse_syncing(),
+                    ),
+                    (
+                        r"^/lighthouse/staking$",
+                        lambda m: api.lighthouse_staking(),
+                    ),
+                    (
+                        r"^/lighthouse/eth1/syncing$",
+                        lambda m: api.lighthouse_eth1_syncing(),
+                    ),
+                    (
+                        r"^/lighthouse/eth1/block_cache$",
+                        lambda m: api.lighthouse_eth1_block_cache(),
+                    ),
+                    (
+                        r"^/lighthouse/eth1/deposit_cache$",
+                        lambda m: api.lighthouse_eth1_deposit_cache(),
+                    ),
+                    (
+                        r"^/lighthouse/merge_readiness$",
+                        lambda m: api.lighthouse_merge_readiness(),
+                    ),
+                    (
                         r"^/lighthouse/proto_array$",
                         lambda m: api.lighthouse_proto_array(),
                     ),
@@ -286,6 +329,16 @@ class BeaconApiServer:
                         r"^/lighthouse/ui/validator_metrics$",
                         lambda m: api.lighthouse_validator_metrics(
                             (self._body() or {}).get("indices", [])
+                        ),
+                    ),
+                    (
+                        r"^/lighthouse/database/reconstruct$",
+                        lambda m: api.lighthouse_database_reconstruct(),
+                    ),
+                    (
+                        r"^/lighthouse/liveness$",
+                        lambda m: api.lighthouse_liveness(
+                            *_liveness_body(self._body())
                         ),
                     ),
                     (

@@ -425,3 +425,68 @@ class TestLighthouseAnalysisRoutes:
                 )
         finally:
             server.stop()
+
+
+class TestLighthouseOperationalRoutes:
+    """The /lighthouse operational namespace (http_api lib.rs:2812-3240):
+    health, syncing, staking, eth1 caches, merge readiness, database
+    reconstruct, liveness."""
+
+    def test_operational_routes(self, rig):
+        h, node, server, client = rig
+        h.extend_chain(3)
+        health = client._get("/lighthouse/health")["data"]
+        assert int(health["head_slot"]) == 3
+        assert client._get("/lighthouse/syncing")["data"] in (
+            "Synced",
+            "SyncingFinalized",
+        )
+        mr = client._get("/lighthouse/merge_readiness")["data"]
+        assert mr["type"] in ("ready", "not_ready")
+        from lighthouse_tpu.http_api.client import Eth2ClientError
+
+        with pytest.raises(Eth2ClientError, match="404"):
+            client._get("/lighthouse/staking")  # no eth1 wired
+        with pytest.raises(Eth2ClientError, match="400"):
+            client._get("/lighthouse/eth1/block_cache")
+        out = client._post("/lighthouse/database/reconstruct", {})["data"]
+        assert "reconstruction complete" in out
+
+    def test_liveness_from_monitor(self):
+        from lighthouse_tpu.chain.validator_monitor import ValidatorMonitor
+        from lighthouse_tpu.http_api import (
+            BeaconApi,
+            BeaconApiServer,
+            BeaconNodeHttpClient,
+        )
+        from lighthouse_tpu.validator_client.beacon_node import (
+            InProcessBeaconNode,
+        )
+
+        h = BeaconChainHarness(
+            16, MINIMAL, ChainSpec.interop(altair_fork_epoch=0)
+        )
+        monitor = ValidatorMonitor(auto_register=True)
+        h.chain.validator_monitor = monitor
+        h.extend_chain(MINIMAL.slots_per_epoch + 2, attest=True)
+        server = BeaconApiServer(BeaconApi(InProcessBeaconNode(h.chain)))
+        server.start()
+        try:
+            client = BeaconNodeHttpClient(
+                f"http://127.0.0.1:{server.port}", MINIMAL
+            )
+            # some monitored validator attested in epoch 1
+            live_any = False
+            for row in client._post(
+                "/lighthouse/liveness",
+                {"indices": list(range(16)), "epoch": 1},
+            )["data"]:
+                live_any = live_any or row["is_live"]
+            assert live_any
+            # nobody is live in a far-future epoch
+            rows = client._post(
+                "/lighthouse/liveness", {"indices": [0, 1], "epoch": 99}
+            )["data"]
+            assert all(not r["is_live"] for r in rows)
+        finally:
+            server.stop()

@@ -1,0 +1,24 @@
+# Convenience targets; CI (.github/workflows/ci.yml) runs the same two.
+
+PY ?= python
+
+.PHONY: lint lint-baseline test test-lint
+
+## lint: AST consensus-safety & TPU-hazard pass (tools/lint, stdlib-only)
+lint:
+	$(PY) -m tools.lint
+
+## lint-baseline: regenerate the ratchet file after burning down debt
+lint-baseline:
+	$(PY) -m tools.lint --write-baseline
+
+## test: tier-1 suite (CPU, excludes slow/TPU-only)
+test:
+	JAX_PLATFORMS=cpu $(PY) -m pytest tests/ -q -m 'not slow' \
+		--continue-on-collection-errors -p no:cacheprovider \
+		-p no:xdist -p no:randomly
+
+## test-lint: just the linter's own fixture suite
+test-lint:
+	JAX_PLATFORMS=cpu $(PY) -m pytest tests/test_lint.py -q \
+		-p no:cacheprovider

@@ -295,8 +295,6 @@ class BeaconChain:
         choice -> head update. Returns the block root. Phases are timed
         into the metrics registry (reference metrics.rs:37-80
         BLOCK_PROCESSING_* family)."""
-        import time as _time
-
         from ..utils import metrics as M
 
         with self.lock, M.BLOCK_PROCESSING_TIMES.time():
@@ -311,8 +309,10 @@ class BeaconChain:
             return block_root  # duplicate: no metrics, no monitor
         M.BLOCKS_IMPORTED.inc()
         if self.validator_monitor is not None:
+            # import time comes from the injected slot clock, so a replay
+            # of the same blocks reports the same timings (wallclock rule)
             self.validator_monitor.on_block_imported(
-                block_root, signed_block.message, _time.monotonic()
+                block_root, signed_block.message, self.slot_clock.now()
             )
         return block_root
 

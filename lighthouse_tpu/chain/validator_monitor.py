@@ -299,6 +299,8 @@ class ValidatorMonitor:
             "attestations_seen": v.attestations_seen,
             "attestations_included": len(v.attestation_min_delay_slots),
             "mean_inclusion_delay": (
+                # lint: allow[float-consensus] -- operator-facing report,
+                # never fed back into state-transition arithmetic
                 sum(delays) / len(delays) if delays else None
             ),
             "last_attestation_slot": v.last_attestation_slot,

@@ -4,6 +4,9 @@ wiring the same component stacks the tests drive in-process.
 
 Entry: python -m lighthouse_tpu.cli <subcommand> ...
 """
+# lint: allow-file[wallclock] -- process entry point: wall clock enters
+# here (genesis defaults, startup deadlines, tool timing) and is handed
+# to the rest of the system as SystemSlotClock / genesis_time
 
 from __future__ import annotations
 
@@ -205,7 +208,13 @@ def build_beacon_node(args):
     store = HotColdDB(kv, preset, spec)
     eth1_service = build_eth1_service(args)
     chain = resolve_genesis(args, store, preset, spec, eth1_service)
-    node = InProcessBeaconNode(chain, eth1_service=eth1_service)
+    from .utils.logging import Logger
+
+    log = Logger(
+        level=getattr(args, "log_level", "info"),
+        json_lines=getattr(args, "log_json", False),
+    ).child(service="bn")
+    node = InProcessBeaconNode(chain, eth1_service=eth1_service, log=log)
     # optional wire networking (lighthouse_network seat): a TCP listener
     # plus bootnode discovery turns this process into a networked peer
     if getattr(args, "listen_port", None) is not None or getattr(

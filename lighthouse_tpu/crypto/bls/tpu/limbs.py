@@ -159,6 +159,9 @@ _TOEP_IDX = np.add.outer(np.arange(2 * W - 1), -np.arange(W)) + W  # in [0, 3W-2
 TOEP_IDX = jnp.asarray(_TOEP_IDX, jnp.int32)
 
 
+# lint: allow[limb-mask] -- raw-column producer BY CONTRACT: callers may
+# combine up to three column vectors before one shared reduce_columns
+# (the Fp2 Karatsuba sharing in tower.py depends on this)
 def mul_columns(a: jnp.ndarray, b: jnp.ndarray) -> jnp.ndarray:
     """Schoolbook product columns: (..., W) x (..., W) -> (..., 2W-1), as a
     Toeplitz-gather + batched matvec (XLA: one gather + one dot_general).

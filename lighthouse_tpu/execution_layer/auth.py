@@ -67,6 +67,8 @@ def generate_token(key: JwtKey, now: float | None = None) -> str:
     """Fresh token with an `iat` claim (auth.rs Auth::generate_token)."""
     header = _b64url(json.dumps({"typ": "JWT", "alg": "HS256"}).encode())
     claims = _b64url(
+        # lint: allow[wallclock] -- JWT iat is wall time by protocol; the
+        # `now` parameter is the injected/testable path
         json.dumps({"iat": int(now if now is not None else time.time())}).encode()
     )
     signing_input = header + b"." + claims
@@ -97,6 +99,7 @@ def validate_token(key: JwtKey, token: str, now: float | None = None) -> dict:
     iat = claims.get("iat")
     if not isinstance(iat, int):
         raise JwtError("missing iat claim")
+    # lint: allow[wallclock] -- iat drift check against real time, as geth does
     t = now if now is not None else time.time()
     if abs(t - iat) > JWT_IAT_WINDOW_S:
         raise JwtError("stale token (iat outside the validity window)")

@@ -146,8 +146,8 @@ class Enr:
                         )
                     ]
                 )
-            except Exception:  # noqa: BLE001 -- malformed record == invalid
-                hit = False
+            except (ValueError, IndexError):  # BlsError is a ValueError:
+                hit = False  # malformed record == invalid, never fatal
             if len(Enr._verified) > 4096:
                 Enr._verified.clear()
             Enr._verified[key] = hit
@@ -340,7 +340,9 @@ class DiscoveryService:
     def _ingest(self, enr_hex: str) -> "Enr | None":
         try:
             enr = Enr.from_bytes(bytes.fromhex(enr_hex))
-        except Exception:  # noqa: BLE001 -- wire boundary
+        except (TypeError, ValueError, IndexError):
+            # remote-controlled input: non-string json value (TypeError),
+            # bad hex / truncated SSZ (SszError is a ValueError)
             return None
         if self.verify_sigs and not enr.verify():
             self.stats["bad_sigs"] += 1
@@ -488,8 +490,14 @@ class DiscoveryService:
                 if not isinstance(msg, dict):
                     continue
                 self._dispatch(msg, addr)
-            except Exception:  # noqa: BLE001 -- one bad datagram must
-                continue  # never kill the recv loop (remote DoS otherwise)
+            # lint: allow[broad-except] -- datagram ingress boundary: a
+            # single crafted packet must never kill the recv loop (remote
+            # DoS otherwise); failures are counted, not dropped silently
+            except Exception:  # noqa: BLE001
+                self.stats["bad_datagrams"] = (
+                    self.stats.get("bad_datagrams", 0) + 1
+                )
+                continue
 
     def _dispatch(self, msg: dict, addr: tuple) -> None:
         t = msg.get("t")

@@ -51,6 +51,7 @@ class NetworkNode:
         bus: MessageBus,
         subscribe_all_subnets: bool = True,
         op_pool=None,
+        log=None,
     ):
         self.peer_id = peer_id
         self.chain = chain
@@ -58,7 +59,7 @@ class NetworkNode:
         # shared with the API node when the CLI wires one in; loads any
         # persisted operations either way (persistence.rs)
         self.op_pool = op_pool or OperationPool.load(
-            chain.store, chain.preset, chain.spec
+            chain.store, chain.preset, chain.spec, log=log
         )
         self.naive_pool = NaiveAggregationPool()
         self.observed_attesters = ObservedAttesters()

@@ -414,7 +414,11 @@ def _verify_register_proof(
                 )
             ]
         )
-    except Exception:  # noqa: BLE001 -- malformed material == invalid
+    except (TypeError, ValueError, IndexError, AttributeError, OverflowError):
+        # remote-controlled input: malformed key/signature material
+        # (BlsError is a ValueError), non-string peer_id/host
+        # (AttributeError/TypeError), out-of-range port (OverflowError)
+        # == invalid registration, never a crashed handler thread
         return False
 
 
@@ -535,9 +539,24 @@ class WireBus:
         secure: bool = False,
         identity_sk=None,
         authenticate: bool = False,
+        rng: random.Random | None = None,
     ):
         self.codec = WireCodec(preset)
         self.host = host
+        # mesh-maintenance randomness (lint rule `nondeterminism`): tests
+        # inject an rng for exact replay; otherwise derive from the node
+        # identity so DISTINCT nodes make independent shuffle/sample
+        # choices (a shared fixed seed would correlate gossip topology
+        # across the whole network) while a fixed identity still replays
+        if rng is not None:
+            self.rng = rng
+        elif identity_sk is not None:
+            digest = hashlib.sha256(
+                b"wirebus-mesh-rng" + identity_sk.to_bytes()
+            ).digest()
+            self.rng = random.Random(int.from_bytes(digest[:8], "big"))
+        else:
+            self.rng = random.Random()  # OS entropy, as before
         # transport security (the noise seat, secure.py): with secure=True
         # every connection -- inbound and outbound -- runs the DH handshake
         # and all frames are encrypted+MACed; authenticate adds BLS
@@ -852,7 +871,7 @@ class WireBus:
                         and pid not in mesh
                         and pid not in self._pruned_by.get(topic, ())
                     ]
-                    random.shuffle(candidates)
+                    self.rng.shuffle(candidates)
                     for pid in candidates[: self.mesh_degree - len(mesh)]:
                         mesh.add(pid)
                         grafts.append((pid, topic))
@@ -1002,7 +1021,7 @@ class WireBus:
                 # carrying peer-exchange suggestions (gossipsub PX) so a
                 # late joiner facing saturated meshes still finds a seat
                 with self._lock:
-                    px = random.sample(
+                    px = self.rng.sample(
                         sorted(self._mesh.get(topic, ())),
                         k=min(2, len(self._mesh.get(topic, ()))),
                     )
@@ -1046,7 +1065,7 @@ class WireBus:
                     and pid not in self._pruned_by[topic]
                     and pid not in candidates
                 ]
-                random.shuffle(others)
+                self.rng.shuffle(others)
                 candidates.extend(others)
                 chosen = candidates[: max(self.mesh_degree - len(mesh), 1)]
                 mesh.update(chosen)
@@ -1102,7 +1121,14 @@ class WireBus:
                     FRAME_RESP,
                     b"\x00" + self.codec.encode_response(protocol, result),
                 )
-            except Exception as e:  # noqa: BLE001 -- wire boundary
+            # lint: allow[broad-except] -- RPC dispatch boundary: the
+            # handler is arbitrary application code and a remote request
+            # must never kill the connection thread; the failure is
+            # counted and returned to the requester, not dropped
+            except Exception as e:  # noqa: BLE001
+                self.stats["rpc_handler_errors"] = (
+                    self.stats.get("rpc_handler_errors", 0) + 1
+                )
                 chan.send_frame(
                     FRAME_RESP, b"\x01" + str(e).encode()[:512]
                 )

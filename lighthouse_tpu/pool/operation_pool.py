@@ -22,6 +22,8 @@ class OperationPool:
         self._proposer_slashings: dict[int, object] = {}
         self._attester_slashings: list = []
         self._voluntary_exits: dict[int, object] = {}
+        # set by load() when a persisted blob only partially decoded
+        self.persist_load_error: str | None = None
 
     # -- attestations (lib.rs:189 insert_attestation) -----------------------
 
@@ -194,7 +196,7 @@ class OperationPool:
         store.put_chain_item(self._PERSIST_KEY, bytes(out))
 
     @classmethod
-    def load(cls, store, preset: Preset, spec) -> "OperationPool":
+    def load(cls, store, preset: Preset, spec, log=None) -> "OperationPool":
         import struct as _s
 
         from ..types.containers import types_for
@@ -222,10 +224,22 @@ class OperationPool:
                     off += 4
                     insert(cls_.from_ssz_bytes(blob[off : off + ln]))
                     off += ln
-        except Exception:  # noqa: BLE001 -- persistence is best-effort BOTH
-            # ways: a corrupt/truncated blob (crash mid-write) must not
-            # crash-loop node startup; restart with whatever decoded
-            pass
+        except (ValueError, IndexError, _s.error) as e:
+            # persistence is best-effort BOTH ways: a corrupt/truncated
+            # blob (crash mid-write, SszError/struct.error) must not
+            # crash-loop node startup; restart with whatever decoded and
+            # surface the partial load for the operator
+            pool.persist_load_error = f"{type(e).__name__}: {e}"
+            if log is None:
+                # fallback stderr sink; callers with a configured logger
+                # (level / json / file) should pass it in
+                from ..utils.logging import Logger
+
+                log = Logger()
+            log.warn(
+                "op-pool persisted blob only partially decoded",
+                error=pool.persist_load_error,
+            )
         return pool
 
     # -- pruning (lib.rs prune_* on finalization) ---------------------------

@@ -171,6 +171,10 @@ class BeaconProcessor:
                     handler(items)
                 else:
                     handler(items[0])
+        # lint: allow[broad-except] -- worker survival boundary: handlers
+        # are arbitrary application callbacks, so the exception type is
+        # unknowable here; the failure is counted per-queue and surfaced
+        # via last_error, never dropped
         except Exception as exc:  # noqa: BLE001 -- a poisoned work item
             # must not kill its worker (mod.rs workers are respawned per
             # task; here the thread persists, so survive and count)

@@ -35,6 +35,7 @@ class InProcessBeaconNode:
         sync_message_pool=None,
         sync_contribution_pool=None,
         eth1_service=None,
+        log=None,
     ):
         from ..chain.sync_committee_verification import (
             ObservedSyncAggregators,
@@ -50,7 +51,7 @@ class InProcessBeaconNode:
         # restart-surviving pool (operation_pool/src/persistence.rs):
         # reload persisted operations from the chain's store
         self.op_pool = op_pool or OperationPool.load(
-            chain.store, chain.preset, chain.spec
+            chain.store, chain.preset, chain.spec, log=log
         )
         self.naive_pool = naive_pool or NaiveAggregationPool()
         self.sync_message_pool = sync_message_pool or SyncMessagePool(

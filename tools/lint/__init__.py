@@ -1,0 +1,27 @@
+"""lighthouse-lint: AST-based consensus-safety & TPU-hazard linter.
+
+A self-contained static-analysis pass (stdlib only) enforcing the
+repo-specific invariants that make the TPU BLS stack safe to serve
+consensus traffic: no ambient wall clock in consensus code, no floats
+in slot/balance arithmetic, deterministic iteration/randomness, no
+jit-recompile or host-sync hazards in the hot kernels, masked limb
+arithmetic, no swallowed exceptions at the processor/network layers.
+
+Run it as ``python -m tools.lint``. Pre-existing violations live in
+``tools/lint/baseline.json`` and are ratcheted: new violations fail,
+the baseline may only shrink.
+
+Suppressions (use sparingly, always with a reason):
+
+    x = time.time()  # lint: allow[wallclock] -- injection boundary
+
+applies to that line; a whole file opts out of one rule with a
+top-of-file comment:
+
+    # lint: allow-file[wallclock] -- process entry point
+
+See README.md "Static analysis" for the rule catalogue.
+"""
+
+from .engine import Violation, lint_paths  # noqa: F401
+from .rules import ALL_RULES  # noqa: F401

@@ -1,0 +1,133 @@
+"""CLI: ``python -m tools.lint [targets...]``.
+
+Exit codes: 0 clean (modulo baseline), 1 new violations or a stale
+baseline, 2 unparsable files.
+"""
+
+from __future__ import annotations
+
+import argparse
+import sys
+from pathlib import Path
+
+from .engine import (
+    BaselineGrowthError,
+    apply_baseline,
+    iter_python_files,
+    lint_paths,
+    load_baseline,
+    write_baseline,
+)
+from .rules import ALL_RULES
+
+DEFAULT_TARGETS = ["lighthouse_tpu", "tools"]
+
+
+def main(argv=None) -> int:
+    parser = argparse.ArgumentParser(
+        prog="python -m tools.lint",
+        description="lighthouse-lint: consensus-safety & TPU-hazard linter",
+    )
+    parser.add_argument(
+        "targets", nargs="*", default=None,
+        help=f"files/dirs relative to the repo root "
+             f"(default: {' '.join(DEFAULT_TARGETS)})",
+    )
+    parser.add_argument(
+        "--root", type=Path, default=Path(__file__).resolve().parents[2],
+        help="lint root (default: the repo root)",
+    )
+    parser.add_argument(
+        "--baseline", type=Path, default=None,
+        help="baseline json (default: tools/lint/baseline.json under root; "
+             "pass --no-baseline to disable)",
+    )
+    parser.add_argument("--no-baseline", action="store_true")
+    parser.add_argument(
+        "--write-baseline", action="store_true",
+        help="regenerate the baseline from the current violations "
+             "(refuses to grow any entry unless --allow-growth)",
+    )
+    parser.add_argument(
+        "--allow-growth", action="store_true",
+        help="with --write-baseline: deliberately grandfather NEW debt",
+    )
+    parser.add_argument(
+        "--list-rules", action="store_true",
+        help="print the rule catalogue and exit",
+    )
+    args = parser.parse_args(argv)
+
+    if args.list_rules:
+        for rule in ALL_RULES:
+            doc = (rule.__doc__ or "").strip().splitlines()[0]
+            print(f"{rule.id:18s} {doc}")
+        return 0
+
+    root = args.root.resolve()
+    targets = args.targets or DEFAULT_TARGETS
+    baseline_path = args.baseline or root / "tools" / "lint" / "baseline.json"
+
+    try:
+        scope = {
+            p.relative_to(root).as_posix()
+            for p in iter_python_files(root, targets)
+        }
+    except FileNotFoundError as e:
+        print(f"error: {e}", file=sys.stderr)
+        return 2
+
+    violations, errors = lint_paths(root, targets)
+    for e in errors:
+        print(f"error: {e}", file=sys.stderr)
+
+    if args.write_baseline:
+        try:
+            counts = write_baseline(
+                baseline_path, violations,
+                allow_growth=args.allow_growth, scope_files=scope,
+            )
+        except BaselineGrowthError as e:
+            print(f"error: {e}", file=sys.stderr)
+            return 1
+        print(
+            f"wrote {baseline_path.relative_to(root)}: "
+            f"{sum(counts.values())} grandfathered violation(s) "
+            f"across {len(counts)} file/rule key(s)"
+        )
+        return 2 if errors else 0
+
+    baseline = {} if args.no_baseline else load_baseline(baseline_path)
+    new, stale = apply_baseline(violations, baseline, scope_files=scope)
+
+    for v in new:
+        print(v)
+    grandfathered = len(violations) - len(new)
+    if grandfathered:
+        print(
+            f"note: {grandfathered} grandfathered violation(s) held by "
+            f"the baseline", file=sys.stderr,
+        )
+    if stale:
+        for key, (recorded, live) in sorted(stale.items()):
+            print(
+                f"stale baseline entry {key}: recorded {recorded}, "
+                f"live {live} -- shrink the baseline "
+                f"(python -m tools.lint --write-baseline)",
+                file=sys.stderr,
+            )
+    if new or stale:
+        print(
+            f"FAILED: {len(new)} new violation(s), "
+            f"{len(stale)} stale baseline entr(ies)",
+            file=sys.stderr,
+        )
+        return 1
+    if errors:
+        return 2
+    print(f"lint clean: {len(violations)} total, all grandfathered or zero")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

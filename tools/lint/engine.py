@@ -1,0 +1,248 @@
+"""Lint engine: file walking, suppression comments, baseline ratchet.
+
+The engine is deliberately rule-agnostic: rules are objects with an
+``id``, a docstring, and a ``check(ctx)`` generator (see rules.py).
+Everything path-related is computed relative to the lint *root*, so the
+same rules run unchanged over the repo and over tiny fixture trees in
+tests.
+"""
+
+from __future__ import annotations
+
+import ast
+import json
+import re
+from dataclasses import dataclass
+from pathlib import Path
+
+# `# lint: allow[rule-a,rule-b] -- optional reason`
+_ALLOW_RE = re.compile(r"#\s*lint:\s*allow\[([a-z0-9_,\- ]+)\]")
+# `# lint: allow-file[rule-a] -- optional reason` (first 10 lines only)
+_ALLOW_FILE_RE = re.compile(r"#\s*lint:\s*allow-file\[([a-z0-9_,\- ]+)\]")
+_ALLOW_FILE_SCAN_LINES = 10
+
+EXCLUDED_PARTS = {
+    ".git",
+    "__pycache__",
+    ".github",
+    "tests",  # fixtures intentionally violate rules
+}
+
+
+@dataclass(frozen=True)
+class Violation:
+    rule: str
+    path: str  # posix path relative to the lint root
+    line: int
+    message: str
+
+    def __str__(self) -> str:
+        return f"{self.path}:{self.line}: [{self.rule}] {self.message}"
+
+    @property
+    def baseline_key(self) -> str:
+        return f"{self.path}::{self.rule}"
+
+
+class LintContext:
+    """One parsed file handed to every rule."""
+
+    def __init__(self, root: Path, path: Path, source: str):
+        self.root = root
+        self.abspath = path
+        self.path = path.relative_to(root).as_posix()
+        self.source = source
+        self.lines = source.splitlines()
+        self.tree = ast.parse(source, filename=str(path))
+        self._line_allows: dict[int, set[str]] = {}
+        self._file_allows: set[str] = set()
+        for i, line in enumerate(self.lines, start=1):
+            m = _ALLOW_RE.search(line)
+            if m:
+                rules = {r.strip() for r in m.group(1).split(",")}
+                self._line_allows.setdefault(i, set()).update(rules)
+            if i <= _ALLOW_FILE_SCAN_LINES:
+                m = _ALLOW_FILE_RE.search(line)
+                if m:
+                    self._file_allows.update(
+                        r.strip() for r in m.group(1).split(",")
+                    )
+
+    def suppressed(self, rule_id: str, line: int) -> bool:
+        if rule_id in self._file_allows:
+            return True
+        allowed = self._line_allows.get(line)
+        if allowed and rule_id in allowed:
+            return True
+        # a standalone allow-comment directly above covers the next code
+        # line; walk up through the contiguous comment block
+        ln = line - 1
+        while ln >= 1 and self.lines[ln - 1].lstrip().startswith("#"):
+            allowed = self._line_allows.get(ln)
+            if allowed and rule_id in allowed:
+                return True
+            ln -= 1
+        return False
+
+    def violation(self, rule_id: str, node: ast.AST, message: str):
+        """Build a Violation unless suppressed; rules yield the result."""
+        line = getattr(node, "lineno", 1)
+        if self.suppressed(rule_id, line):
+            return None
+        return Violation(rule_id, self.path, line, message)
+
+
+def iter_python_files(root: Path, targets: list[str] | None = None):
+    bases = [root / t for t in targets] if targets else [root]
+    # a typo'd or non-python target must never turn into a green
+    # "checked 0 files" run
+    missing = [b for b in bases if not b.exists()]
+    if missing:
+        raise FileNotFoundError(
+            "lint target(s) do not exist: "
+            + ", ".join(str(b) for b in missing)
+        )
+    non_py = [b for b in bases if b.is_file() and b.suffix != ".py"]
+    if non_py:
+        raise FileNotFoundError(
+            "lint target(s) are not python files: "
+            + ", ".join(str(b) for b in non_py)
+        )
+    seen = set()
+    for base in bases:
+        paths = [base] if base.is_file() else sorted(base.rglob("*.py"))
+        for p in paths:
+            if p.suffix != ".py" or p in seen:
+                continue
+            if any(part in EXCLUDED_PARTS for part in p.relative_to(root).parts):
+                continue
+            seen.add(p)
+            yield p
+
+
+def lint_paths(root: Path, targets: list[str] | None = None, rules=None):
+    """Lint files under root; returns (violations, parse_errors)."""
+    from .rules import ALL_RULES
+
+    rules = list(rules) if rules is not None else list(ALL_RULES)
+    violations: list[Violation] = []
+    errors: list[str] = []
+    try:
+        files = list(iter_python_files(root, targets))
+    except FileNotFoundError as e:
+        return [], [str(e)]
+    for path in files:
+        try:
+            source = path.read_text(encoding="utf-8")
+            ctx = LintContext(root, path, source)
+        except (SyntaxError, UnicodeDecodeError, ValueError) as e:
+            errors.append(f"{path}: unparsable: {e}")
+            continue
+        for rule in rules:
+            violations.extend(v for v in rule.check(ctx) if v is not None)
+    violations.sort(key=lambda v: (v.path, v.line, v.rule))
+    return violations, errors
+
+
+# --- baseline ratchet -------------------------------------------------------
+#
+# The baseline maps "path::rule" -> count of grandfathered violations.
+# A run FAILS when any key's live count exceeds its baseline count (new
+# violation), and also when the live count has dropped below the
+# baseline (the fix must be locked in by shrinking the committed file:
+# the baseline may only shrink, never silently re-inflate).
+
+
+class BaselineGrowthError(Exception):
+    """--write-baseline would grandfather NEW debt (fix it instead)."""
+
+    def __init__(self, grown: dict):
+        self.grown = grown
+        super().__init__(
+            "refusing to grow the baseline for: "
+            + ", ".join(
+                f"{k} ({old} -> {new})" for k, (old, new) in sorted(grown.items())
+            )
+            + " -- fix the new violations, or pass --allow-growth to "
+            "grandfather them deliberately"
+        )
+
+
+def load_baseline(path: Path) -> dict[str, int]:
+    if not path.exists():
+        return {}
+    data = json.loads(path.read_text())
+    return {str(k): int(v) for k, v in data.get("violations", {}).items()}
+
+
+def write_baseline(
+    path: Path,
+    violations: list[Violation],
+    allow_growth: bool = False,
+    scope_files: set[str] | None = None,
+) -> dict[str, int]:
+    counts: dict[str, int] = {}
+    for v in violations:
+        counts[v.baseline_key] = counts.get(v.baseline_key, 0) + 1
+    had_baseline = path.exists()
+    old = load_baseline(path) if had_baseline else {}
+    if scope_files is not None:
+        # regenerating over a SUBSET of the tree must not wipe entries
+        # for files that simply were not linted this run
+        for key, count in old.items():
+            if key.rsplit("::", 1)[0] not in scope_files:
+                counts[key] = count
+    # guard on FILE existence, not emptiness: the committed empty
+    # baseline is the ratchet's floor, not a bootstrap state
+    if not allow_growth and had_baseline:
+        # the ratchet: regenerating must never grandfather NEW debt --
+        # that would let `--write-baseline` silently green a regression
+        # (bootstrap of a brand-new baseline file is always allowed)
+        grown = {
+            k: (old.get(k, 0), c)
+            for k, c in counts.items()
+            if c > old.get(k, 0)
+        }
+        if grown:
+            raise BaselineGrowthError(grown)
+    payload = {
+        "comment": (
+            "Grandfathered lint debt, keyed by 'path::rule'. Ratcheted: "
+            "new violations fail CI; when you fix one, regenerate with "
+            "`python -m tools.lint --write-baseline` so the file shrinks."
+        ),
+        "violations": dict(sorted(counts.items())),
+    }
+    path.write_text(json.dumps(payload, indent=2) + "\n")
+    return counts
+
+
+def apply_baseline(
+    violations: list[Violation],
+    baseline: dict[str, int],
+    scope_files: set[str] | None = None,
+):
+    """Split live violations against the baseline.
+
+    Returns (new, stale) where `new` is the list of violations beyond
+    each key's grandfathered count and `stale` maps baseline keys whose
+    live count is now LOWER than recorded (ratchet: shrink the file).
+    With `scope_files` (a subset lint run), staleness is only judged
+    for files that were actually linted.
+    """
+    live: dict[str, int] = {}
+    for v in violations:
+        live[v.baseline_key] = live.get(v.baseline_key, 0) + 1
+    new: list[Violation] = []
+    spent: dict[str, int] = {}
+    for v in violations:
+        spent[v.baseline_key] = spent.get(v.baseline_key, 0) + 1
+        if spent[v.baseline_key] > baseline.get(v.baseline_key, 0):
+            new.append(v)
+    stale = {
+        k: (c, live.get(k, 0))
+        for k, c in baseline.items()
+        if live.get(k, 0) < c
+        and (scope_files is None or k.rsplit("::", 1)[0] in scope_files)
+    }
+    return new, stale

@@ -1,0 +1,636 @@
+"""The rule catalogue. Each rule is a small object with:
+
+  * ``id``      -- the name used in reports, baselines and suppressions
+  * a docstring -- the invariant it enforces and why it is load-bearing
+  * ``check(ctx)`` -- generator over a parsed file (engine.LintContext)
+                      yielding ``ctx.violation(...)`` results
+
+Scopes are matched as directory substrings of the root-relative path,
+so the rules run identically over ``lighthouse_tpu/state_transition/``
+in the repo and ``state_transition/`` in a test fixture tree.
+"""
+
+from __future__ import annotations
+
+import ast
+
+CONSENSUS_DIRS = ("state_transition/", "fork_choice/", "chain/")
+SERIALIZATION_DIRS = ("ssz/", "types/")
+BOUNDARY_DIRS = ("processor/", "network/", "eth1/")
+TPU_DIRS = ("crypto/bls/tpu/", "parallel/")
+LIMB_FILES = ("limbs.py", "tower.py")
+
+
+def _in_dirs(ctx, prefixes) -> bool:
+    slashed = "/" + ctx.path
+    return any("/" + p in slashed for p in prefixes)
+
+
+def _dotted(node) -> str | None:
+    """``a.b.c`` for a Name/Attribute chain, else None."""
+    parts = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+        return ".".join(reversed(parts))
+    return None
+
+
+def _names_in(node) -> set[str]:
+    return {n.id for n in ast.walk(node) if isinstance(n, ast.Name)}
+
+
+def _import_bindings(tree, module: str):
+    """Names a module is reachable under in this file.
+
+    Returns (aliases, from_names): `aliases` is every name bound to the
+    module itself (``import time``, ``import time as _t``), `from_names`
+    maps local name -> original name for ``from module import x [as y]``.
+    Rules use this so ``from time import time`` cannot dodge a ban that
+    matches ``time.time()``.
+    """
+    aliases: set[str] = set()
+    from_names: dict[str, str] = {}
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Import):
+            for a in node.names:
+                if a.name == module:
+                    aliases.add(a.asname or a.name)
+        elif isinstance(node, ast.ImportFrom) and node.module == module:
+            for a in node.names:
+                from_names[a.asname or a.name] = a.name
+    return aliases, from_names
+
+
+def _is_jit_decorator(dec):
+    """Recognise @jit / @jax.jit / @jax.jit(...) / @partial(jax.jit, ...).
+
+    Returns (True, static_param_names_or_nums) or (False, None).
+    """
+    call = dec if isinstance(dec, ast.Call) else None
+    target = call.func if call else dec
+    dotted = _dotted(target) or ""
+    statics: set = set()
+
+    def _collect_statics(c: ast.Call):
+        for kw in c.keywords:
+            if kw.arg in ("static_argnames", "static_argnums"):
+                vals = (
+                    kw.value.elts
+                    if isinstance(kw.value, (ast.Tuple, ast.List))
+                    else [kw.value]
+                )
+                for v in vals:
+                    if isinstance(v, ast.Constant):
+                        statics.add(v.value)
+
+    if dotted.split(".")[-1] == "jit":
+        if call:
+            _collect_statics(call)
+        return True, statics
+    if dotted.split(".")[-1] == "partial" and call and call.args:
+        inner = _dotted(call.args[0]) or ""
+        if inner.split(".")[-1] == "jit":
+            _collect_statics(call)
+            return True, statics
+    return False, None
+
+
+def _iter_jit_functions(tree):
+    """Yield (fn_node, traced_param_names) for jit-decorated functions."""
+    for node in ast.walk(tree):
+        if not isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            continue
+        for dec in node.decorator_list:
+            is_jit, statics = _is_jit_decorator(dec)
+            if not is_jit:
+                continue
+            args = node.args
+            all_params = [
+                a.arg
+                for a in (args.posonlyargs + args.args + args.kwonlyargs)
+            ]
+            traced = {
+                name
+                for pos, name in enumerate(all_params)
+                if name not in statics and pos not in statics
+            }
+            yield node, traced
+            break
+
+
+# --------------------------------------------------------------------------
+
+
+class WallClockRule:
+    """wallclock: consensus code must take the slot clock as a parameter.
+
+    ``time.time()`` / ``datetime.now()`` / ``datetime.utcnow()`` are
+    banned everywhere in library code (wall clock enters only at the
+    injection boundaries -- ``cli.py`` and ``utils/slot_clock.py``,
+    which carry explicit file-level suppressions). ``time.monotonic()``
+    is additionally banned inside ``state_transition/``, ``fork_choice/``
+    and ``chain/``: even a monotonic read there makes a state transition
+    depend on when it ran rather than on the slot it was given.
+    """
+
+    id = "wallclock"
+
+    def check(self, ctx):
+        consensus = _in_dirs(ctx, CONSENSUS_DIRS)
+        time_aliases, time_froms = _import_bindings(ctx.tree, "time")
+        _dt_aliases, dt_froms = _import_bindings(ctx.tree, "datetime")
+        for node in ast.walk(ctx.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            dotted = _dotted(node.func)
+            if not dotted:
+                continue
+            parts = dotted.split(".")
+            if len(parts) == 1:
+                # bare call via `from time import time [as x]`
+                orig = time_froms.get(parts[0])
+                if orig == "time":
+                    yield ctx.violation(
+                        self.id, node,
+                        "wall-clock read (from-import of time.time); "
+                        "thread the slot clock / genesis_time through "
+                        "instead",
+                    )
+                elif consensus and orig == "monotonic":
+                    yield ctx.violation(
+                        self.id, node,
+                        "monotonic clock read inside consensus code; take "
+                        "the timestamp as a parameter",
+                    )
+                continue
+            head, tail = parts[-2], parts[-1]
+            if head in dt_froms:
+                head = dt_froms[head]  # `from datetime import datetime as d`
+            is_time_mod = head in ("time", "_time") or head in time_aliases
+            if is_time_mod and tail == "time":
+                yield ctx.violation(
+                    self.id, node,
+                    "wall-clock read; thread the slot clock / genesis_time "
+                    "through instead",
+                )
+            elif head in ("datetime", "date") and tail in (
+                "now", "utcnow", "today"
+            ):
+                yield ctx.violation(
+                    self.id, node,
+                    f"wall-clock read ({dotted}); consensus code must be "
+                    "replayable at any time",
+                )
+            elif consensus and is_time_mod and tail == "monotonic":
+                yield ctx.violation(
+                    self.id, node,
+                    "monotonic clock read inside consensus code; take the "
+                    "timestamp as a parameter",
+                )
+
+
+class FloatConsensusRule:
+    """float-consensus: no float literals or true division in consensus
+    arithmetic.
+
+    Slots, epochs, balances and committee math in ``state_transition/``,
+    ``fork_choice/`` and ``chain/`` are exact integer domains; a float
+    creeping in (or a ``/`` where ``//`` was meant) rounds differently
+    across platforms and forks the state root.
+    """
+
+    id = "float-consensus"
+
+    def check(self, ctx):
+        if not _in_dirs(ctx, CONSENSUS_DIRS):
+            return
+        for node in ast.walk(ctx.tree):
+            if isinstance(node, ast.Constant) and isinstance(
+                node.value, float
+            ):
+                yield ctx.violation(
+                    self.id, node,
+                    f"float literal {node.value!r} in consensus code",
+                )
+            elif isinstance(node, (ast.BinOp, ast.AugAssign)) and isinstance(
+                node.op, ast.Div
+            ):
+                yield ctx.violation(
+                    self.id, node,
+                    "true division in consensus code; use // (or suppress "
+                    "for reporting-only paths)",
+                )
+
+
+class NondeterminismRule:
+    """nondeterminism: no unseeded randomness, no set-order dependence.
+
+    Module-level ``random.X()`` draws from interpreter-global state, so
+    two runs of the simulator or discovery walk diverge; inject a
+    ``random.Random(seed)`` instead. Direct iteration over a set inside
+    consensus or SSZ/tree-hash code makes output ordering depend on hash
+    seeding -- sort first.
+    """
+
+    id = "nondeterminism"
+
+    _SEEDED = ("Random", "SystemRandom", "getstate", "setstate")
+
+    def check(self, ctx):
+        ordered_scope = _in_dirs(ctx, CONSENSUS_DIRS + SERIALIZATION_DIRS)
+        rnd_aliases, rnd_froms = _import_bindings(ctx.tree, "random")
+        rnd_aliases = rnd_aliases | {"random"}
+        for node in ast.walk(ctx.tree):
+            if (
+                isinstance(node, ast.Call)
+                and isinstance(node.func, ast.Attribute)
+                and isinstance(node.func.value, ast.Name)
+                and node.func.value.id in rnd_aliases
+                and node.func.attr not in self._SEEDED
+            ):
+                yield ctx.violation(
+                    self.id, node,
+                    f"module-level random.{node.func.attr}() is unseeded; "
+                    "inject a random.Random(seed)",
+                )
+            elif (
+                isinstance(node, ast.Call)
+                and isinstance(node.func, ast.Name)
+                and node.func.id in rnd_froms
+                and rnd_froms[node.func.id] not in self._SEEDED
+            ):
+                yield ctx.violation(
+                    self.id, node,
+                    f"from-imported random.{rnd_froms[node.func.id]}() is "
+                    "unseeded; inject a random.Random(seed)",
+                )
+            elif ordered_scope and isinstance(
+                node, (ast.For, ast.AsyncFor)
+            ):
+                it = node.iter
+                is_set = isinstance(it, (ast.Set, ast.SetComp)) or (
+                    isinstance(it, ast.Call)
+                    and isinstance(it.func, ast.Name)
+                    and it.func.id in ("set", "frozenset")
+                )
+                if is_set:
+                    yield ctx.violation(
+                        self.id, node,
+                        "iteration over a set in ordering-sensitive code; "
+                        "sort first",
+                    )
+
+
+class JitRecompileRule:
+    """jit-recompile: no Python branching on traced values inside jit.
+
+    A Python ``if``/``while`` on a traced argument inside ``@jax.jit``
+    either raises a ConcretizationError or -- with shape-dependent
+    values -- silently retraces and recompiles per call, the 100x-latency
+    failure mode of the TPU verify path. Branch with ``lax.cond`` /
+    ``jnp.where``, or mark the argument static.
+    """
+
+    id = "jit-recompile"
+
+    def check(self, ctx):
+        if not _in_dirs(ctx, TPU_DIRS):
+            return
+        for fn, traced in _iter_jit_functions(ctx.tree):
+            for node in ast.walk(fn):
+                if isinstance(node, (ast.If, ast.While)):
+                    hit = _names_in(node.test) & traced
+                    if hit:
+                        yield ctx.violation(
+                            self.id, node,
+                            f"Python branch on traced value(s) "
+                            f"{sorted(hit)} inside @jit "
+                            f"'{fn.name}'; use lax.cond/jnp.where or "
+                            "static_argnames",
+                        )
+
+
+class HostSyncRule:
+    """host-sync: no device->host synchronisation in the hot kernels.
+
+    ``.item()``, ``.tolist()``, ``np.asarray()``/``np.array()``,
+    ``jax.device_get()`` and ``float()/int()/bool()`` on traced values
+    block on the accelerator and serialise the verify pipeline. Inside
+    ``crypto/bls/tpu/`` and ``parallel/`` these belong only at the
+    explicit host boundary (suppress there with a reason).
+    """
+
+    id = "host-sync"
+
+    _SYNC_ATTRS = ("item", "tolist")
+    _SYNC_FUNCS = ("device_get", "asarray", "array")
+
+    def check(self, ctx):
+        if not _in_dirs(ctx, TPU_DIRS):
+            return
+        jit_spans = []  # (fn, traced) for containment checks
+        for fn, traced in _iter_jit_functions(ctx.tree):
+            jit_spans.append((fn, traced))
+            for node in ast.walk(fn):
+                if not isinstance(node, ast.Call):
+                    continue
+                if (
+                    isinstance(node.func, ast.Attribute)
+                    and node.func.attr in self._SYNC_ATTRS
+                ):
+                    yield ctx.violation(
+                        self.id, node,
+                        f".{node.func.attr}() inside @jit '{fn.name}' "
+                        "forces a host sync",
+                    )
+                    continue
+                dotted = _dotted(node.func) or ""
+                parts = dotted.split(".")
+                if len(parts) >= 2 and parts[-1] in self._SYNC_FUNCS and (
+                    parts[-2] in ("np", "numpy", "jax", "onp")
+                ):
+                    yield ctx.violation(
+                        self.id, node,
+                        f"{dotted}() inside @jit '{fn.name}' leaves the "
+                        "device",
+                    )
+                elif (
+                    isinstance(node.func, ast.Name)
+                    and node.func.id in ("float", "int", "bool")
+                    and node.args
+                    and _names_in(node.args[0]) & traced
+                ):
+                    yield ctx.violation(
+                        self.id, node,
+                        f"{node.func.id}() on traced value inside @jit "
+                        f"'{fn.name}' forces a host sync",
+                    )
+
+
+class LimbMaskRule:
+    """limb-mask: raw limb products must flow through a reduction.
+
+    In ``limbs.py``/``tower.py`` the int32 lanes overflow silently once
+    column sums exceed 2^31; every function that multiplies limb arrays
+    (``*``, ``einsum``, ``matmul``, ``dot``) must call one of the
+    carry/fold/reduce/canon/mask primitives before its result escapes.
+    The static check is per-function: a multiply with no reduction call
+    in the same function is flagged.
+    """
+
+    id = "limb-mask"
+
+    _REDUCERS = ("carry", "fold", "reduce", "canon", "mask", "mod", "norm")
+    _MULTIPLY_FUNCS = ("einsum", "matmul", "dot", "tensordot")
+    _SCALARISH = (ast.Constant, ast.List, ast.Tuple)
+
+    def check(self, ctx):
+        basename = ctx.path.rsplit("/", 1)[-1]
+        if basename not in LIMB_FILES or not _in_dirs(ctx, TPU_DIRS):
+            return
+        for fn in ast.walk(ctx.tree):
+            if not isinstance(fn, ast.FunctionDef):
+                continue
+            if any(r in fn.name for r in self._REDUCERS):
+                continue  # the reduction primitives themselves
+            # host-side helpers (pure python/np ints) are out of scope;
+            # only functions touching device arrays carry overflow risk
+            on_device = any(
+                isinstance(n, ast.Name) and n.id == "jnp"
+                for n in ast.walk(fn)
+            )
+            multiplies = False
+            reduces = False
+            for node in ast.walk(fn):
+                if isinstance(node, ast.BinOp) and isinstance(
+                    node.op, ast.Mult
+                ):
+                    # constant scaling (x * 2) and list-repetition are
+                    # in-range; flag only array-by-array products
+                    if on_device and not any(
+                        isinstance(s, self._SCALARISH)
+                        for s in (node.left, node.right)
+                    ):
+                        multiplies = True
+                if isinstance(node, ast.Call):
+                    dotted = _dotted(node.func) or ""
+                    leaf = dotted.split(".")[-1]
+                    if leaf in self._MULTIPLY_FUNCS:
+                        multiplies = True
+                    if any(r in leaf for r in self._REDUCERS):
+                        reduces = True
+            if multiplies and not reduces:
+                yield ctx.violation(
+                    self.id, fn,
+                    f"'{fn.name}' multiplies limb arrays but never calls a "
+                    "carry/fold/reduce/canon primitive",
+                )
+
+
+class BroadExceptRule:
+    """broad-except: no swallowed exceptions at the service boundaries.
+
+    Bare ``except:`` is banned everywhere. ``except Exception`` inside
+    ``processor/``, ``network/`` and ``eth1/`` must be narrowed to the
+    concrete types the callee raises -- or carry an explicit suppression
+    naming why the boundary must survive arbitrary failures (and the
+    handler must record the error, never drop it). A handler whose body
+    is only ``pass`` is flagged everywhere.
+    """
+
+    id = "broad-except"
+
+    def _is_broad(self, type_node) -> bool:
+        if type_node is None:
+            return True
+        names = []
+        if isinstance(type_node, ast.Tuple):
+            names = [_dotted(e) for e in type_node.elts]
+        else:
+            names = [_dotted(type_node)]
+        return any(
+            n in ("Exception", "BaseException", "builtins.Exception")
+            for n in names
+            if n
+        )
+
+    def check(self, ctx):
+        boundary = _in_dirs(ctx, BOUNDARY_DIRS)
+        for node in ast.walk(ctx.tree):
+            if not isinstance(node, ast.ExceptHandler):
+                continue
+            silent = all(
+                isinstance(s, ast.Pass)
+                or (
+                    isinstance(s, ast.Expr)
+                    and isinstance(s.value, ast.Constant)
+                    and s.value.value is Ellipsis
+                )
+                for s in node.body
+            )
+            if node.type is None:
+                yield ctx.violation(
+                    self.id, node,
+                    "bare except: catches SystemExit/KeyboardInterrupt; "
+                    "name the exception types",
+                )
+            elif self._is_broad(node.type):
+                if silent:
+                    yield ctx.violation(
+                        self.id, node,
+                        "except Exception: pass silently swallows every "
+                        "failure",
+                    )
+                elif boundary:
+                    yield ctx.violation(
+                        self.id, node,
+                        "broad except at a service boundary; narrow to the "
+                        "expected types (or suppress with a reason and log "
+                        "the error)",
+                    )
+
+
+class AsyncBlockingRule:
+    """async-blocking: no synchronous blocking calls inside async def.
+
+    ``time.sleep``, blocking socket construction, ``subprocess`` and
+    ``urllib``/``requests`` calls inside a coroutine stall the entire
+    event loop -- in ``network/`` that means every peer at once. Use the
+    async equivalents or push the work onto an executor.
+    """
+
+    id = "async-blocking"
+
+    _BLOCKING = {
+        "time.sleep",
+        "_time.sleep",
+        "socket.socket",
+        "socket.create_connection",
+        "subprocess.run",
+        "subprocess.call",
+        "subprocess.check_call",
+        "subprocess.check_output",
+        "subprocess.Popen",
+        "urllib.request.urlopen",
+        "requests.get",
+        "requests.post",
+        "requests.request",
+    }
+
+    def check(self, ctx):
+        for node in ast.walk(ctx.tree):
+            if not isinstance(node, ast.AsyncFunctionDef):
+                continue
+            for call in ast.walk(node):
+                if isinstance(call, ast.Call):
+                    dotted = _dotted(call.func)
+                    if dotted in self._BLOCKING:
+                        yield ctx.violation(
+                            self.id, call,
+                            f"blocking {dotted}() inside async def "
+                            f"'{node.name}' stalls the event loop",
+                        )
+
+
+class MutableDefaultRule:
+    """mutable-default: no mutable default arguments.
+
+    A ``def f(x, acc=[])`` default is evaluated once and shared across
+    calls -- state leaks between invocations (and between tests). Use
+    ``None`` and construct inside.
+    """
+
+    id = "mutable-default"
+
+    def check(self, ctx):
+        for node in ast.walk(ctx.tree):
+            if not isinstance(
+                node, (ast.FunctionDef, ast.AsyncFunctionDef, ast.Lambda)
+            ):
+                continue
+            defaults = list(node.args.defaults) + [
+                d for d in node.args.kw_defaults if d is not None
+            ]
+            for d in defaults:
+                mutable = isinstance(
+                    d,
+                    (ast.List, ast.Dict, ast.Set, ast.ListComp,
+                     ast.DictComp, ast.SetComp),
+                ) or (
+                    isinstance(d, ast.Call)
+                    and isinstance(d.func, ast.Name)
+                    and d.func.id in ("list", "dict", "set", "bytearray")
+                )
+                if mutable:
+                    name = getattr(node, "name", "<lambda>")
+                    yield ctx.violation(
+                        self.id, d,
+                        f"mutable default argument in '{name}'; default to "
+                        "None and construct inside",
+                    )
+
+
+class TracerLeakRule:
+    """tracer-leak: no storing traced values outside the jit scope.
+
+    Assigning a traced array to ``self.x`` or a module global inside a
+    ``@jax.jit`` function leaks the tracer: it escapes its trace, and
+    any later use raises ``UnexpectedTracerError`` (or worse, bakes a
+    stale constant into the next compilation). Return values instead.
+    """
+
+    id = "tracer-leak"
+
+    def check(self, ctx):
+        if not _in_dirs(ctx, TPU_DIRS):
+            return
+        for fn, _traced in _iter_jit_functions(ctx.tree):
+            globals_declared: set[str] = set()
+            for node in ast.walk(fn):
+                if isinstance(node, ast.Global):
+                    globals_declared.update(node.names)
+            for node in ast.walk(fn):
+                targets = []
+                if isinstance(node, ast.Assign):
+                    targets = node.targets
+                elif isinstance(node, (ast.AugAssign, ast.AnnAssign)):
+                    targets = [node.target]
+                for t in targets:
+                    if (
+                        isinstance(t, ast.Attribute)
+                        and isinstance(t.value, ast.Name)
+                        and t.value.id in ("self", "cls")
+                    ):
+                        yield ctx.violation(
+                            self.id, node,
+                            f"assignment to {t.value.id}.{t.attr} inside "
+                            f"@jit '{fn.name}' leaks a tracer",
+                        )
+                    elif (
+                        isinstance(t, ast.Name)
+                        and t.id in globals_declared
+                    ):
+                        yield ctx.violation(
+                            self.id, node,
+                            f"assignment to global '{t.id}' inside @jit "
+                            f"'{fn.name}' leaks a tracer",
+                        )
+
+
+ALL_RULES = [
+    WallClockRule(),
+    FloatConsensusRule(),
+    NondeterminismRule(),
+    JitRecompileRule(),
+    HostSyncRule(),
+    LimbMaskRule(),
+    BroadExceptRule(),
+    AsyncBlockingRule(),
+    MutableDefaultRule(),
+    TracerLeakRule(),
+]
+
+RULES_BY_ID = {r.id: r for r in ALL_RULES}

@@ -2,7 +2,7 @@
 
 PY ?= python
 
-.PHONY: lint lint-baseline test test-lint
+.PHONY: lint lint-baseline test test-lint test-chaos
 
 ## lint: AST consensus-safety & TPU-hazard pass (tools/lint, stdlib-only)
 lint:
@@ -22,3 +22,8 @@ test:
 test-lint:
 	JAX_PLATFORMS=cpu $(PY) -m pytest tests/test_lint.py -q \
 		-p no:cacheprovider
+
+## test-chaos: deterministic fault-injection suite (the CI chaos job)
+test-chaos:
+	JAX_PLATFORMS=cpu $(PY) -m pytest tests/test_resilience.py -q \
+		-m chaos -p no:cacheprovider

@@ -138,6 +138,9 @@ def resolve_genesis(args, store, preset, spec, eth1_service=None):
             600.0 if timeout_s is None else float(timeout_s)
         )
         update_failures = 0
+        # lint: allow[retry-no-backoff] -- deadline-bounded genesis poll
+        # (the SystemExit below caps it); the fixed 2s cadence IS the
+        # genesis-detection interval, not a transport retry
         while True:
             state = try_genesis_from_eth1(eth1_service, preset, spec)
             if state is not None:

@@ -12,6 +12,8 @@ the reference's compile-time feature flags at crypto/bls/src/lib.rs:8-20):
   * ``jax_tpu``  -- the TPU batch verifier (the blst-equivalent hot path)
   * ``cpu``      -- pure-Python oracle pairing (the milagro-equivalent)
   * ``fake``     -- always-valid stub (fake_crypto; state-transition tests)
+  * ``fallback`` -- jax_tpu behind a circuit breaker, degrading to cpu on
+                    device faults and re-probing back (backends/fallback.py)
 
 Keys and signatures carry their affine oracle points plus compressed bytes;
 group membership is enforced at `PublicKey` construction (the reference
@@ -236,7 +238,9 @@ _BACKEND_NAME = None
 
 
 def set_backend(name: str) -> None:
-    """Select the verification backend: 'jax_tpu', 'cpu', or 'fake'."""
+    """Select the verification backend: 'jax_tpu', 'cpu', 'fake', or
+    'fallback' (jax_tpu with circuit-breakered degradation to cpu --
+    backends/fallback.py)."""
     global _BACKEND, _BACKEND_NAME
     if name == "cpu":
         from .backends import cpu as mod
@@ -244,6 +248,8 @@ def set_backend(name: str) -> None:
         from .backends import fake as mod
     elif name == "jax_tpu":
         from .backends import jax_tpu as mod
+    elif name == "fallback":
+        from .backends import fallback as mod
     else:
         raise BlsError(f"unknown BLS backend {name!r}")
     _BACKEND, _BACKEND_NAME = mod, name

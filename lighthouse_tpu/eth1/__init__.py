@@ -11,6 +11,9 @@ from .jsonrpc import (  # noqa: F401
 )
 from .service import (  # noqa: F401
     Eth1Block,
+    Eth1ProviderError,
     Eth1Service,
+    FallbackEth1Provider,
     MockEth1Provider,
+    NoEth1ProviderAvailable,
 )

@@ -14,7 +14,7 @@ from __future__ import annotations
 
 from ..types.containers import DepositData
 from ..utils.jsonrpc import JsonRpcClient, JsonRpcHttpServer
-from .service import Eth1Block
+from .service import Eth1Block, Eth1ProviderError
 
 DEPOSIT_CONTRACT_ADDRESS = "0x" + "12" * 20
 # keccak("DepositEvent(bytes,bytes,bytes,bytes,bytes)") — fixed topic of the
@@ -79,8 +79,9 @@ def decode_deposit_log_data(data: bytes) -> tuple[DepositData, int]:
 # -- client provider ----------------------------------------------------------
 
 
-class Eth1RpcError(RuntimeError):
-    pass
+class Eth1RpcError(Eth1ProviderError):
+    """RPC/transport failure after the client's own bounded retries --
+    the transient shape FallbackEth1Provider fails over on."""
 
 
 class JsonRpcEth1Provider:

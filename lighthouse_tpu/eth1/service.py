@@ -19,12 +19,30 @@ from __future__ import annotations
 import hashlib
 from dataclasses import dataclass
 
+from ..resilience.primitives import AllEndpointsFailed, EventLog, HealthTracker
 from ..types.containers import Eth1Data
 from .deposit_tree import DepositDataTree
 
 
 class Eth1DepositsUnavailable(RuntimeError):
     """Block production asked for deposits the log cache lacks."""
+
+
+class Eth1ProviderError(RuntimeError):
+    """Endpoint-side failure an eth1 provider surfaces after its own
+    client-side retries (jsonrpc.Eth1RpcError subclasses this)."""
+
+
+class NoEth1ProviderAvailable(ConnectionError):
+    """Every ranked eth1 endpoint failed the call."""
+
+
+# errors a provider endpoint may raise transiently: transport faults
+# (ConnectionError covers injected FaultPlan errors, TimeoutError/OSError
+# cover sockets and injected hangs) and the providers' own error shape.
+# Deliberately NOT bare RuntimeError: NotImplementedError/RecursionError
+# are programming errors, not outages, and must propagate.
+TRANSIENT_PROVIDER_ERRORS = (ConnectionError, OSError, Eth1ProviderError)
 
 
 @dataclass
@@ -87,8 +105,91 @@ class MockEth1Provider:
         return [d for d, _ in self.deposit_logs[from_index:]]
 
 
+class FallbackEth1Provider:
+    """Ranked multi-endpoint eth1 provider (the reference's eth1
+    multi-endpoint cache, SURVEY §1 layer 5): implements the same
+    provider duck type over a list of endpoints.
+
+    Each call walks the endpoints in HealthTracker order -- recent
+    outcomes rank them, demoted endpoints sink to the back until their
+    re-probe budget matures -- so a dead primary stops eating the first
+    try but is probed again once it may have recovered. A fallback that
+    is BEHIND the primary is fine: `Eth1Service.update()` already treats
+    a shorter/diverged remote view as a reorg and rewinds, then re-
+    extends when the primary returns (chaos-tested in
+    tests/test_resilience.py)."""
+
+    def __init__(
+        self,
+        providers,
+        tracker: HealthTracker | None = None,
+        events: EventLog | None = None,
+    ):
+        self.providers = list(providers)
+        self.tracker = tracker or HealthTracker(
+            window=4, threshold=0.5, reprobe_after_skips=2, name="eth1"
+        )
+        self.events = events
+        self.active_index: int | None = None
+
+    def _call(self, method: str, *args):
+        def on_error(i, e):
+            if self.events is not None:
+                self.events.record(
+                    "eth1_endpoint_error", index=i, method=method,
+                    error=type(e).__name__,
+                )
+
+        try:
+            i, out = self.tracker.failover(
+                self.providers,
+                lambda p: getattr(p, method)(*args),
+                retry_on=TRANSIENT_PROVIDER_ERRORS,
+                on_error=on_error,
+            )
+        except AllEndpointsFailed as e:
+            raise NoEth1ProviderAvailable(
+                f"all {len(self.providers)} eth1 endpoints failed {method}"
+            ) from e.last
+        if self.events is not None and self.active_index != i:
+            self.events.record("eth1_endpoint_switch", index=i)
+        self.active_index = i
+        return out
+
+    # -- provider duck type (Eth1Service contract) ---------------------------
+
+    def head_number(self) -> int:
+        return self._call("head_number")
+
+    def get_block(self, number: int):
+        return self._call("get_block", number)
+
+    def get_deposit_logs(self, from_index: int) -> list:
+        return self._call("get_deposit_logs", from_index)
+
+    def reset_log_scan(self) -> None:
+        """Fan out to EVERY endpoint that keeps a scan watermark: after a
+        reorg, a later failover to an endpoint with a stale watermark
+        must not resurrect reorged-out logs."""
+        for p in self.providers:
+            reset = getattr(p, "reset_log_scan", None)
+            if reset is None:
+                continue
+            try:
+                reset()
+            except TRANSIENT_PROVIDER_ERRORS:
+                # the endpoint is down; its watermark resets when its
+                # transport reconnects (reset_log_scan is local state in
+                # every real provider, so this is fault-injection only)
+                continue
+
+
 class Eth1Service:
     def __init__(self, provider, follow_distance: int = 4):
+        # a list of endpoints gets the ranked-fallback treatment; a bare
+        # provider keeps the original single-endpoint behavior
+        if isinstance(provider, (list, tuple)):
+            provider = FallbackEth1Provider(provider)
         self.provider = provider
         self.follow_distance = follow_distance
         self.deposit_tree = DepositDataTree()

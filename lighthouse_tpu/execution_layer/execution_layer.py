@@ -15,12 +15,23 @@ from __future__ import annotations
 
 import enum
 
+from ..resilience.primitives import RetryExhausted, RetryPolicy
 from .engine_api import (
     EngineApiError,
     ForkchoiceState,
     PayloadAttributes,
     PayloadStatusV1Status,
 )
+
+# engine faults worth re-attempting: the API's own error shape plus
+# transport errors (ConnectionError covers injected FaultPlan faults,
+# TimeoutError/OSError cover sockets and injected hangs). EngineApiError
+# is deliberately included even though it also covers semantic JSON-RPC
+# rejections: the HTTP transport (http_engine.py/utils/jsonrpc.py)
+# surfaces exhausted transport retries AS EngineApiError, and the
+# reference treats an erroring engine like a syncing one (optimistic
+# posture) rather than trusting it to distinguish its own failures.
+TRANSIENT_ENGINE_ERRORS = (EngineApiError, ConnectionError, OSError)
 
 
 class PayloadVerificationStatus(str, enum.Enum):
@@ -44,9 +55,20 @@ class ExecutionLayer:
         engine,
         suggested_fee_recipient: bytes = b"\x00" * 20,
         pre_merge_parent_hash: bytes | None = None,
+        retry_policy: RetryPolicy | None = None,
+        syncing_retry_attempts: int = 0,
     ):
         self.engine = engine
         self.suggested_fee_recipient = suggested_fee_recipient
+        # resilience (opt-in, injected): with a RetryPolicy, transient
+        # engine faults retry with backoff; an engine still unreachable
+        # after the budget degrades newPayload to OPTIMISTIC (the
+        # reference's optimistic-sync posture toward an offline engine)
+        # while fcU/getPayload fail loudly. `syncing_retry_attempts`
+        # additionally re-polls a SYNCING newPayload before settling for
+        # the optimistic import.
+        self.retry_policy = retry_policy
+        self.syncing_retry_attempts = syncing_retry_attempts
         # the EL block to build the transition payload on before the merge
         # completes (terminal block seat); in-process mocks default to their
         # own genesis, remote engines must be told explicitly
@@ -105,6 +127,13 @@ class ExecutionLayer:
             return None
         return pow_parent[1] < spec.terminal_total_difficulty
 
+    def _engine_call(self, fn):
+        """One engine round trip under the injected retry policy (none
+        configured -> single attempt, errors propagate as before)."""
+        if self.retry_policy is None:
+            return fn()
+        return self.retry_policy.call(fn, retry_on=TRANSIENT_ENGINE_ERRORS)
+
     # -- verification path (block import) -----------------------------------
 
     def notify_new_payload(self, payload) -> PayloadVerificationStatus:
@@ -118,20 +147,44 @@ class ExecutionLayer:
             verify_payload_block_hash(payload)
         except ValueError as e:
             raise PayloadInvalid(str(e)) from None
-        status = self.engine.new_payload(payload)
-        s = status.status
-        if s == PayloadStatusV1Status.VALID:
-            return PayloadVerificationStatus.VERIFIED
-        if s in (
-            PayloadStatusV1Status.SYNCING,
-            PayloadStatusV1Status.ACCEPTED,
-        ):
-            return PayloadVerificationStatus.OPTIMISTIC
-        raise PayloadInvalid(
-            f"execution payload invalid: {s.value}"
-            + (f" ({status.validation_error})" if status.validation_error else ""),
-            status.latest_valid_hash,
-        )
+        syncing_budget = self.syncing_retry_attempts
+        while True:
+            try:
+                status = self._engine_call(
+                    lambda: self.engine.new_payload(payload)
+                )
+            except RetryExhausted:
+                # the engine stayed unreachable through the retry budget:
+                # treat it like a SYNCING engine and import optimistically
+                # (payload_status.rs posture; fork choice re-checks later)
+                return PayloadVerificationStatus.OPTIMISTIC
+            s = status.status
+            if s == PayloadStatusV1Status.VALID:
+                return PayloadVerificationStatus.VERIFIED
+            if s in (
+                PayloadStatusV1Status.SYNCING,
+                PayloadStatusV1Status.ACCEPTED,
+            ):
+                if s == PayloadStatusV1Status.SYNCING and syncing_budget > 0:
+                    # re-poll a syncing engine before settling for the
+                    # optimistic import -- it may catch up within the
+                    # backoff window
+                    syncing_budget -= 1
+                    if self.retry_policy is not None:
+                        self.retry_policy.pause(
+                            self.syncing_retry_attempts - syncing_budget - 1
+                        )
+                    continue
+                return PayloadVerificationStatus.OPTIMISTIC
+            raise PayloadInvalid(
+                f"execution payload invalid: {s.value}"
+                + (
+                    f" ({status.validation_error})"
+                    if status.validation_error
+                    else ""
+                ),
+                status.latest_valid_hash,
+            )
 
     def notify_forkchoice_updated(
         self,
@@ -147,7 +200,9 @@ class ExecutionLayer:
             ),
             finalized_block_hash=finalized_block_hash,
         )
-        resp = self.engine.forkchoice_updated(state, attributes)
+        resp = self._engine_call(
+            lambda: self.engine.forkchoice_updated(state, attributes)
+        )
         if resp.payload_status.status == PayloadStatusV1Status.INVALID:
             raise PayloadInvalid(
                 "forkchoiceUpdated: head payload invalid",
@@ -206,4 +261,8 @@ class ExecutionLayer:
         )
         if resp.payload_id is None:
             raise EngineApiError("engine did not start payload build")
-        return self.engine.get_payload(resp.payload_id)
+        # block production must fail loudly: retries smooth transient
+        # faults, but an exhausted budget propagates (no silent degrade)
+        return self._engine_call(
+            lambda: self.engine.get_payload(resp.payload_id)
+        )

@@ -21,10 +21,20 @@ class Simulator:
         validator_count: int,
         preset: Preset,
         spec: ChainSpec | None = None,
+        fault_plan=None,
     ):
         self.preset = preset
         self.spec = spec or ChainSpec.interop()
         self.bus = MessageBus()
+        self.fault_plan = fault_plan
+        if fault_plan is not None:
+            # chaos mode: every node talks to the bus through the seeded
+            # FaultPlan (resilience/faults.py), so req/resp calls see
+            # deterministic injected transport faults -- the sync
+            # retry/penalty paths run for real instead of only on
+            # hand-scripted broken handlers. Only `request` is faulted:
+            # req/resp is where the retry machinery lives.
+            self.bus = fault_plan.wrap(self.bus, "bus", methods=("request",))
         self.producer = StateHarness(
             validator_count, preset, self.spec, sign=False
         )

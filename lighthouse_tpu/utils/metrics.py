@@ -97,6 +97,37 @@ class Histogram:
         return out
 
 
+class LabeledGauge:
+    """A one-label gauge family (`name{label="x"} v` per child): the
+    minimal labels support the resilience layer needs for per-endpoint
+    health scores without pulling in a full label model."""
+
+    def __init__(self, name: str, help_: str, label: str = "endpoint"):
+        self.name = name
+        self.help = help_
+        self.label = label
+        self._children: dict[str, float] = {}
+        self._lock = threading.Lock()
+
+    def set(self, label_value: str, value: float) -> None:
+        with self._lock:
+            self._children[str(label_value)] = value
+
+    def get(self, label_value: str) -> float | None:
+        return self._children.get(str(label_value))
+
+    def expose(self) -> list[str]:
+        out = [
+            f"# HELP {self.name} {self.help}",
+            f"# TYPE {self.name} gauge",
+        ]
+        with self._lock:
+            items = sorted(self._children.items())
+        for lv, v in items:
+            out.append(f'{self.name}{{{self.label}="{lv}"}} {v:g}')
+        return out
+
+
 class Registry:
     def __init__(self):
         self._metrics: dict[str, object] = {}
@@ -117,6 +148,9 @@ class Registry:
 
     def histogram(self, name: str, help_: str = "", buckets=_DEFAULT_BUCKETS):
         return self._get(Histogram, name, help_, buckets=buckets)
+
+    def labeled_gauge(self, name: str, help_: str = "", label: str = "endpoint"):
+        return self._get(LabeledGauge, name, help_, label=label)
 
     def expose(self) -> str:
         with self._lock:
@@ -168,4 +202,31 @@ BLOCKS_REJECTED = REGISTRY.counter(
 # process globals -- multiple chains share one process in the simulator.
 ATTESTATIONS_PROCESSED = REGISTRY.counter(
     "beacon_attestations_processed_total", "Gossip attestations verified"
+)
+
+# -- the resilience metric family (lighthouse_tpu/resilience/) ----------------
+# Retry attempts, breaker transitions, BLS backend degradation, and
+# per-endpoint health scores: the observable surface of graceful
+# degradation (reference: beacon_node_fallback / eth1 endpoint metrics).
+
+RETRY_ATTEMPTS = REGISTRY.counter(
+    "resilience_retry_attempts_total",
+    "Operations re-attempted by a RetryPolicy",
+)
+BREAKER_TRANSITIONS = REGISTRY.counter(
+    "resilience_breaker_transitions_total",
+    "Circuit-breaker state transitions (closed/open/half-open)",
+)
+BLS_FALLBACK_EVENTS = REGISTRY.counter(
+    "bls_backend_fallback_total",
+    "Batches degraded from the primary BLS backend to the fallback",
+)
+BLS_USING_FALLBACK = REGISTRY.gauge(
+    "bls_backend_using_fallback",
+    "1 while BLS verification is degraded to the fallback backend",
+)
+ENDPOINT_HEALTH = REGISTRY.labeled_gauge(
+    "resilience_endpoint_health_score",
+    "Recent-outcome health score per tracked endpoint (0..1)",
+    label="endpoint",
 )

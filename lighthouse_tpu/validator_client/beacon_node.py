@@ -25,6 +25,8 @@ from ..types import (
 from ..types.containers import block_classes_for
 from ..types.presets import Preset
 
+_BN_COUNTER = 0  # distinct health-metric label per in-process node
+
 
 class InProcessBeaconNode:
     def __init__(
@@ -70,12 +72,43 @@ class InProcessBeaconNode:
         # the follow distance and packs the deposits the winning vote owes
         # (reference eth1/src/service.rs + block production deposits)
         self.eth1_service = eth1_service
-        self.healthy = True  # toggled by tests to exercise VC failover
+        # health is SCORED, not a boolean: recent outcomes feed a
+        # HealthTracker (resilience/primitives.py), the same machinery
+        # BeaconNodeFallback ranks remote nodes with -- so VC failover
+        # tests exercise the real scoring path. `healthy = False` (the
+        # old test toggle) now floods the window with failures.
+        from ..resilience.primitives import HealthTracker
+
+        # unique tracker name per node: each BN exports its own
+        # resilience_endpoint_health_score{endpoint="bn<N>/self"} series
+        # instead of every node clobbering one label
+        global _BN_COUNTER
+        _BN_COUNTER += 1
+        self.health = HealthTracker(
+            window=4, threshold=0.5, name=f"bn{_BN_COUNTER}"
+        )
 
     # -- status --------------------------------------------------------------
 
+    _HEALTH_KEY = "self"
+
     def is_healthy(self) -> bool:
-        return self.healthy
+        return self.health.is_healthy(self._HEALTH_KEY)
+
+    def record_health(self, ok: bool) -> None:
+        """Feed one observed outcome into the health score."""
+        self.health.record(self._HEALTH_KEY, bool(ok))
+
+    @property
+    def healthy(self) -> bool:
+        return self.is_healthy()
+
+    @healthy.setter
+    def healthy(self, up: bool) -> None:
+        # saturate the outcome window so the score flips decisively --
+        # the toggle drives the scoring path instead of bypassing it
+        for _ in range(self.health.window):
+            self.record_health(up)
 
     def genesis_validators_root(self) -> bytes:
         return bytes(self.chain.head_state.genesis_validators_root)

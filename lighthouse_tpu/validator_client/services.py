@@ -10,6 +10,7 @@ duty polling per epoch."""
 from __future__ import annotations
 
 from ..chain.attestation_verification import is_aggregator
+from ..resilience.primitives import AllEndpointsFailed, EventLog, HealthTracker
 from ..types import compute_epoch_at_slot, types_for
 from ..types.presets import Preset
 from .validator_store import DoppelgangerHold, ValidatorStore
@@ -21,30 +22,69 @@ class NoHealthyBeaconNode(RuntimeError):
 
 
 class BeaconNodeFallback:
-    """Ranked multi-BN redundancy (beacon_node_fallback.rs:293-300):
-    first healthy candidate wins; candidates re-rank on failure."""
+    """Health-scored multi-BN redundancy (beacon_node_fallback.rs:293):
+    candidates are ranked by a HealthTracker over recent call outcomes
+    (replacing first-healthy-wins), so a node that keeps failing duties
+    sinks below a working one even while its own `is_healthy()` still
+    says yes. Demoted nodes re-probe after a bounded number of passes
+    (the reference's candidate re-check loop), so a recovered node wins
+    its ranking back instead of being skipped forever."""
 
-    def __init__(self, candidates):
+    def __init__(
+        self,
+        candidates,
+        tracker: HealthTracker | None = None,
+        events: EventLog | None = None,
+    ):
         self.candidates = list(candidates)
+        self.tracker = tracker or HealthTracker(
+            window=4, threshold=0.5, reprobe_after_skips=2, name="beacon_node"
+        )
+        self.events = events
+
+    def ranked(self):
+        """Candidates best-first: healthy-or-reprobe-due by descending
+        score, then demoted nodes as a last resort."""
+        order = self.tracker.ranked(range(len(self.candidates)))
+        return [self.candidates[i] for i in order]
 
     def best(self):
-        for node in self.candidates:
+        for node in self.ranked():
             if node.is_healthy():
                 return node
         raise NoHealthyBeaconNode("no healthy beacon node available")
 
+    def record_outcome(self, node, ok: bool) -> None:
+        """Feed one duty outcome for `node` into the ranking tracker
+        (the per-slot duty loop reports here; see ValidatorClient.on_slot)."""
+        for i, candidate in enumerate(self.candidates):
+            if candidate is node:
+                self.tracker.record(i, ok)
+                return
+
     def call(self, fn):
-        last_err = None
-        for node in list(self.candidates):
-            if not node.is_healthy():
-                continue
-            try:
-                return fn(node)
-            except Exception as e:  # noqa: BLE001 -- reference retries broadly
-                last_err = e
-        if last_err is not None:
-            raise last_err
-        raise NoHealthyBeaconNode("no healthy beacon node available")
+        def on_error(i, e):
+            if self.events is not None:
+                self.events.record(
+                    "bn_call_failed", index=i, error=type(e).__name__
+                )
+
+        try:
+            _, out = self.tracker.failover(
+                self.candidates,
+                fn,
+                retry_on=(Exception,),  # noqa: BLE001 -- reference
+                # retries duty calls broadly (beacon_node_fallback.rs)
+                skip=lambda node: not node.is_healthy(),
+                on_error=on_error,
+            )
+        except AllEndpointsFailed as e:
+            if e.last is not None:
+                raise e.last
+            raise NoHealthyBeaconNode(
+                "no healthy beacon node available"
+            ) from None
+        return out
 
 
 class DutiesService:
@@ -193,10 +233,20 @@ class ValidatorClient:
             self._aggregation_duty,
             self._sync_aggregation_duty,
         ):
+            # each duty's outcome feeds the fallback's HealthTracker:
+            # a node whose duties keep failing is demoted in the ranking
+            # (and re-probed later), so failover engages from the REAL
+            # duty path, not only from tests
+            node = None
             try:
+                node = self.nodes.best()
                 duty(slot)
             except Exception as e:  # noqa: BLE001
                 self.duty_errors.append((slot, duty.__name__, str(e)))
+                if node is not None:
+                    self.nodes.record_outcome(node, False)
+            else:
+                self.nodes.record_outcome(node, True)
 
     # -- preparation / fee recipients (preparation_service.rs) ---------------
 

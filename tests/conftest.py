@@ -43,6 +43,11 @@ if os.environ.get("LIGHTHOUSE_TPU_TEST_CACHE") == "1":
 
 def pytest_configure(config):
     config.addinivalue_line("markers", "slow: long-running scale benchmark")
+    config.addinivalue_line(
+        "markers",
+        "chaos: deterministic fault-injection tests (resilience layer); "
+        "CI also runs these as a dedicated step",
+    )
 
 
 def pytest_collection_modifyitems(session, config, items):

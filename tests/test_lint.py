@@ -550,6 +550,127 @@ def test_async_blocking_negative_asyncio_sleep(tmp_path):
     assert "async-blocking" not in rules_hit(vs)
 
 
+# --- retry-no-backoff -------------------------------------------------------
+
+
+def test_retry_no_backoff_positive_no_sleep(tmp_path):
+    vs = lint_fixture(
+        tmp_path, "eth1/thing.py",
+        """
+        def fetch(call):
+            last = None
+            for attempt in range(3):
+                try:
+                    return call()
+                except OSError as e:
+                    last = e
+            raise last
+        """,
+    )
+    assert "retry-no-backoff" in rules_hit(vs)
+
+
+def test_retry_no_backoff_positive_constant_sleep(tmp_path):
+    vs = lint_fixture(
+        tmp_path, "utils/thing.py",
+        """
+        import time
+        def fetch(call):
+            for _ in range(5):
+                try:
+                    return call()
+                except ConnectionError:
+                    time.sleep(0.05)
+        """,
+    )
+    assert "retry-no-backoff" in rules_hit(vs)
+
+
+def test_retry_no_backoff_positive_while_true_unbounded(tmp_path):
+    vs = lint_fixture(
+        tmp_path, "network/thing.py",
+        """
+        def fetch(call):
+            while True:
+                try:
+                    return call()
+                except ConnectionError:
+                    continue
+        """,
+    )
+    assert "retry-no-backoff" in rules_hit(vs)
+
+
+def test_retry_no_backoff_negative_exponential(tmp_path):
+    vs = lint_fixture(
+        tmp_path, "utils/thing.py",
+        """
+        import time
+        def fetch(call, backoff_s):
+            last = None
+            for attempt in range(5):
+                try:
+                    return call()
+                except ConnectionError as e:
+                    last = e
+                    time.sleep(backoff_s * (2 ** attempt))
+            raise last
+        """,
+    )
+    assert "retry-no-backoff" not in rules_hit(vs)
+
+
+def test_retry_no_backoff_negative_peer_rotation(tmp_path):
+    vs = lint_fixture(
+        tmp_path, "network/thing.py",
+        """
+        def ask_any(peers, ask):
+            for peer in peers:
+                try:
+                    return ask(peer)
+                except (ConnectionError, OSError):
+                    continue
+            return None
+        """,
+    )
+    assert "retry-no-backoff" not in rules_hit(vs)
+
+
+def test_retry_no_backoff_negative_data_sweep_over_range(tmp_path):
+    """A range loop whose variable feeds real calls is a data sweep
+    (slots/indices), not an attempt counter."""
+    vs = lint_fixture(
+        tmp_path, "store/thing.py",
+        """
+        def scan(load, n):
+            out = []
+            for slot in range(n):
+                try:
+                    out.append(load(slot))
+                except KeyError:
+                    continue
+            return out
+        """,
+    )
+    assert "retry-no-backoff" not in rules_hit(vs)
+
+
+def test_retry_no_backoff_negative_conditional_while(tmp_path):
+    """Server/poll loops with a real condition carry their own bound."""
+    vs = lint_fixture(
+        tmp_path, "network/thing.py",
+        """
+        def serve(stopped, recv):
+            while not stopped():
+                try:
+                    recv()
+                except OSError:
+                    continue
+        """,
+    )
+    assert "retry-no-backoff" not in rules_hit(vs)
+
+
 # --- mutable-default --------------------------------------------------------
 
 
@@ -744,7 +865,7 @@ def test_baseline_empty_means_any_violation_is_new():
 
 def test_rule_catalogue_complete():
     """Every rule has an id, a docstring, and appears in the registry."""
-    assert len(ALL_RULES) == 10
+    assert len(ALL_RULES) == 11
     for rule in ALL_RULES:
         assert rule.id and rule.id == rule.id.lower()
         assert rule.__doc__ and rule.id in rule.__doc__.split(":")[0]

@@ -118,6 +118,98 @@ class TestChainMetricsAndMonitor:
             server.stop()
 
 
+class TestResilienceMetrics:
+    """The resilience layer's observable surface (utils/metrics.py):
+    retry attempts, breaker transitions, BLS backend fallback events,
+    and per-endpoint health scores."""
+
+    def test_retry_attempts_counted(self):
+        from lighthouse_tpu.resilience import RetryPolicy, VirtualClock
+        from lighthouse_tpu.utils.metrics import RETRY_ATTEMPTS
+
+        before = RETRY_ATTEMPTS.value
+        calls = []
+
+        def flaky():
+            calls.append(1)
+            if len(calls) < 3:
+                raise ConnectionError("down")
+            return "ok"
+
+        policy = RetryPolicy(max_attempts=4, clock=VirtualClock())
+        assert policy.call(flaky) == "ok"
+        assert RETRY_ATTEMPTS.value == before + 2
+
+    def test_breaker_transitions_counted(self):
+        from lighthouse_tpu.resilience import CircuitBreaker, VirtualClock
+        from lighthouse_tpu.utils.metrics import BREAKER_TRANSITIONS
+
+        before = BREAKER_TRANSITIONS.value
+        clock = VirtualClock()
+        b = CircuitBreaker(clock=clock, failure_threshold=1, reset_timeout_s=1)
+        b.record_failure()  # closed -> open
+        clock.advance(2)
+        assert b.allow()  # open -> half-open
+        b.record_success()  # half-open -> closed
+        assert BREAKER_TRANSITIONS.value == before + 3
+
+    def test_bls_fallback_events_and_gauge(self):
+        from lighthouse_tpu.crypto.bls.backends.fallback import (
+            FallbackBackend,
+        )
+        from lighthouse_tpu.resilience import CircuitBreaker, VirtualClock
+        from lighthouse_tpu.utils.metrics import (
+            BLS_FALLBACK_EVENTS,
+            BLS_USING_FALLBACK,
+        )
+
+        class StubBackend:
+            def __init__(self, fail=False):
+                self.fail = fail
+                self.calls = 0
+
+            def verify_signature_sets(self, sets, seed=None):
+                self.calls += 1
+                if self.fail:
+                    raise ConnectionError("device lost")
+                return True
+
+        primary, oracle = StubBackend(fail=True), StubBackend()
+        clock = VirtualClock()
+        backend = FallbackBackend(
+            primary=primary,
+            fallback=oracle,
+            breaker=CircuitBreaker(
+                clock=clock, failure_threshold=1, reset_timeout_s=5
+            ),
+        )
+        before = BLS_FALLBACK_EVENTS.value
+        assert backend.verify_signature_sets([], seed=0) is True
+        assert BLS_FALLBACK_EVENTS.value == before + 1
+        assert BLS_USING_FALLBACK.value == 1
+        # recovery: the half-open probe flips the gauge back
+        primary.fail = False
+        clock.advance(6)
+        assert backend.verify_signature_sets([], seed=0) is True
+        assert BLS_USING_FALLBACK.value == 0
+        assert backend.active_backend_name() == "jax_tpu"
+
+    def test_endpoint_health_scores_exposed_with_labels(self):
+        from lighthouse_tpu.resilience import HealthTracker
+        from lighthouse_tpu.utils.metrics import ENDPOINT_HEALTH, REGISTRY
+
+        t = HealthTracker(window=4, name="unittest_eth1")
+        t.record("ep0", True)
+        t.record("ep0", False)
+        assert ENDPOINT_HEALTH.get("unittest_eth1/ep0") == 0.5
+        text = REGISTRY.expose()
+        assert (
+            'resilience_endpoint_health_score{endpoint="unittest_eth1/ep0"}'
+            " 0.5" in text
+        )
+        assert "# TYPE resilience_endpoint_health_score gauge" in text
+
+
 class TestDuplicateImports:
     def test_duplicate_import_not_double_counted(self):
         from lighthouse_tpu.utils.metrics import REGISTRY as R

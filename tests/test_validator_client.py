@@ -201,11 +201,57 @@ class TestFallback:
         h2 = BeaconChainHarness(16, MINIMAL, ChainSpec.interop())
         node2 = InProcessBeaconNode(h2.chain)
         vc.nodes = BeaconNodeFallback([node, node2])
+        # `healthy = False` floods the node's HealthTracker window -- the
+        # toggle drives the real scoring path, not a test-only boolean
         node.healthy = False
+        assert node.health.score(node._HEALTH_KEY) == 0.0
         assert vc.nodes.best() is node2
         node2.healthy = False
         with pytest.raises(NoHealthyBeaconNode):
             vc.nodes.best()
+
+    def test_call_outcomes_demote_and_rerank_candidates(self):
+        """beacon_node_fallback.rs candidate ranking: duty-call failures
+        demote a node below a working peer; successes keep it ranked."""
+        h, node, vc = make_vc()
+        h2 = BeaconChainHarness(16, MINIMAL, ChainSpec.interop())
+        node2 = InProcessBeaconNode(h2.chain)
+        from lighthouse_tpu.resilience import HealthTracker
+
+        fb = BeaconNodeFallback(
+            [node, node2],
+            tracker=HealthTracker(
+                window=2, threshold=0.75, reprobe_after_skips=10
+            ),
+        )
+
+        def flaky_first(n):
+            if n is node:
+                raise ConnectionError("node0 duty endpoint down")
+            return "served"
+
+        assert fb.call(flaky_first) == "served"  # rotated to node2
+        assert fb.tracker.score(0) < fb.tracker.score(1)
+        assert fb.ranked()[0] is node2  # demoted node0 lost its slot
+        # node0's own health check still says yes -- the SCORE demoted it
+        assert node.is_healthy()
+
+    def test_duty_loop_survives_mid_epoch_failover(self):
+        """Duties keep flowing when the first node dies mid-epoch: the
+        scored fallback re-ranks and the second node serves."""
+        h, node, vc = make_vc(validators=16, register=16)
+        node2 = InProcessBeaconNode(h.chain)  # same chain, second "BN"
+        vc.nodes = BeaconNodeFallback([node, node2])
+        vc.duties.nodes = vc.nodes
+        for slot in range(1, MINIMAL.slots_per_epoch + 1):
+            h.chain.slot_clock.set_slot(slot)
+            h.chain.on_tick()
+            if slot == 3:
+                node.healthy = False  # floods the scoring window
+            vc.on_slot(slot)
+        assert vc.attestations_published > 0
+        assert len(vc.blocks_proposed) == MINIMAL.slots_per_epoch
+        assert vc.nodes.best() is node2
 
 
 class TestDoppelganger:

@@ -535,6 +535,149 @@ class AsyncBlockingRule:
                         )
 
 
+class RetryNoBackoffRule:
+    """retry-no-backoff: retry loops must be bounded and back off.
+
+    A loop that swallows an exception and re-attempts (an except handler
+    that neither raises, returns, nor breaks) is a retry loop. Two
+    failure shapes are flagged: ``while True`` retry loops (unbounded
+    attempts hammer a dead dependency forever) and ``for _ in range(n)``
+    attempt loops whose body never sleeps -- or sleeps a constant --
+    between attempts (lockstep constant retries synchronize every
+    client into a thundering herd; back off exponentially with jitter,
+    e.g. resilience.RetryPolicy). Not flagged: loops rotating over
+    DIFFERENT endpoints (``for peer in peers``), conditional ``while``
+    loops (server/poll loops with their own bound), and range loops
+    whose variable feeds ordinary calls (data sweeps over slots/indices,
+    not attempt counters).
+    """
+
+    id = "retry-no-backoff"
+
+    _SLEEPY = ("sleep", "backoff", "delay", "pause", "wait")
+
+    @staticmethod
+    def _own_nodes(loop):
+        """Walk a loop's body without descending into nested loops or
+        function definitions (their retry behavior is judged on their
+        own loop node / call site)."""
+        stack = list(loop.body) + list(getattr(loop, "orelse", []))
+        while stack:
+            node = stack.pop()
+            yield node
+            if isinstance(
+                node,
+                (ast.For, ast.AsyncFor, ast.While,
+                 ast.FunctionDef, ast.AsyncFunctionDef, ast.Lambda),
+            ):
+                continue
+            stack.extend(ast.iter_child_nodes(node))
+
+    def _retries(self, loop) -> bool:
+        """The loop contains an except handler that re-attempts."""
+        for node in self._own_nodes(loop):
+            if not isinstance(node, ast.ExceptHandler):
+                continue
+            exits = any(
+                isinstance(n, (ast.Raise, ast.Return, ast.Break))
+                for s in node.body
+                for n in ast.walk(s)
+            )
+            if not exits:
+                return True
+        return False
+
+    def _backoff_quality(self, loop) -> str:
+        """'none' | 'constant' | 'ok' for the sleeps inside the loop."""
+        best = "none"
+        for node in self._own_nodes(loop):
+            if not isinstance(node, ast.Call):
+                continue
+            leaf = (_dotted(node.func) or "").split(".")[-1].lower()
+            if not any(s in leaf for s in self._SLEEPY):
+                continue
+            if any(
+                not isinstance(a, ast.Constant) for a in node.args
+            ) or node.keywords:
+                return "ok"
+            best = "constant"
+        return best
+
+    def _is_data_sweep(self, loop) -> bool:
+        """The range variable feeds ordinary (non-sleep) calls: the loop
+        sweeps data keyed by the index (slots, validator indices), it
+        does not count attempts."""
+        names = {
+            t.id
+            for t in ast.walk(loop.target)
+            if isinstance(t, ast.Name)
+        }
+        if not names:
+            return False
+        for node in self._own_nodes(loop):
+            if not isinstance(node, ast.Call):
+                continue
+            leaf = (_dotted(node.func) or "").split(".")[-1].lower()
+            if any(s in leaf for s in self._SLEEPY):
+                continue
+            used = {
+                n.id
+                for a in list(node.args) + [k.value for k in node.keywords]
+                for n in ast.walk(a)
+                if isinstance(n, ast.Name)
+            }
+            if used & names:
+                return True
+        return False
+
+    def check(self, ctx):
+        for loop in ast.walk(ctx.tree):
+            if not isinstance(loop, (ast.For, ast.While)):
+                continue
+            if not self._retries(loop):
+                continue
+            if isinstance(loop, ast.While):
+                # conditional whiles carry their own bound (server and
+                # poll loops); only while True is an unbounded retry
+                if not (
+                    isinstance(loop.test, ast.Constant)
+                    and loop.test.value is True
+                ):
+                    continue
+                yield ctx.violation(
+                    self.id, loop,
+                    "unbounded retry loop (while True swallowing "
+                    "errors); cap the attempts",
+                )
+                continue
+            # only attempt-count loops are same-target retries;
+            # iterating a collection is endpoint rotation
+            it = loop.iter
+            is_range = (
+                isinstance(it, ast.Call)
+                and (_dotted(it.func) or "").split(".")[-1] == "range"
+            )
+            if not is_range:
+                continue
+            if self._is_data_sweep(loop):
+                continue
+            quality = self._backoff_quality(loop)
+            if quality == "none":
+                yield ctx.violation(
+                    self.id, loop,
+                    "retry loop without backoff; sleep an exponential/"
+                    "jittered delay between attempts (resilience."
+                    "RetryPolicy)",
+                )
+            elif quality == "constant":
+                yield ctx.violation(
+                    self.id, loop,
+                    "retry loop with CONSTANT backoff synchronizes "
+                    "clients into a thundering herd; scale the delay by "
+                    "the attempt (and jitter it)",
+                )
+
+
 class MutableDefaultRule:
     """mutable-default: no mutable default arguments.
 
@@ -629,6 +772,7 @@ ALL_RULES = [
     LimbMaskRule(),
     BroadExceptRule(),
     AsyncBlockingRule(),
+    RetryNoBackoffRule(),
     MutableDefaultRule(),
     TracerLeakRule(),
 ]

@@ -565,6 +565,11 @@ class LightClientStore:
         has_committee = is_sync_committee_update(update)
         if not (current_slot >= sig_slot):
             raise LightClientError("update signed in the future")
+        # full spec slot ordering (validate_light_client_update):
+        # current_slot >= sig_slot > attested_slot >= finalized_slot; the
+        # attested >= finalized half rides the has_finality branch below
+        if not (sig_slot > attested_slot):
+            raise LightClientError("signature slot not after attested slot")
         if has_finality and attested_slot < finalized_slot:
             raise LightClientError("attested before finalized")
         if not has_finality:

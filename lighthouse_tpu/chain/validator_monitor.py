@@ -216,7 +216,12 @@ class ValidatorMonitor:
             self._last_evaluated_epoch is not None
             and prev_epoch > self._last_evaluated_epoch
         ):
-            self._count_retired_epoch(self._last_evaluated_epoch)
+            # a multi-epoch head jump retires EVERY epoch the watermark
+            # skips over, not just the watermark itself -- intermediate
+            # epochs graded on earlier head changes must still count
+            # their misses (they can never be re-graded once retired)
+            for epoch in range(self._last_evaluated_epoch, prev_epoch):
+                self._count_retired_epoch(epoch)
         # a reorg can move the head to an EARLIER epoch; never regress the
         # watermark or a later advance would retire (and count) the same
         # epoch twice

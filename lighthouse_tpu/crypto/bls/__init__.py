@@ -31,4 +31,5 @@ from .api import (  # noqa: F401
     set_backend,
     verify,
     verify_signature_sets,
+    verify_signature_sets_async,
 )

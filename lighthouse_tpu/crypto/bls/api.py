@@ -277,6 +277,21 @@ def verify_signature_sets(sets, seed: int | None = None) -> bool:
     return _ensure_backend().verify_signature_sets(sets, seed=seed)
 
 
+def verify_signature_sets_async(sets, seed: int | None = None):
+    """Pipelined batch-verify: marshal + enqueue now, answer later.
+
+    Returns a ``pipeline.VerifyFuture`` whose ``result()`` yields exactly
+    what ``verify_signature_sets`` would have returned for the same sets
+    and seed. Host marshalling for the NEXT batch overlaps device compute
+    for this one (JAX async dispatch); futures resolve in submit order.
+    Backends without an async dispatch hook (cpu, fake, fallback) compute
+    eagerly at submit -- same futures, no behavioral difference.
+    """
+    from .pipeline import default_pipeline
+
+    return default_pipeline().submit(sets, seed=seed)
+
+
 def verify(signature: Signature, pubkeys, message: bytes) -> bool:
     """fast_aggregate_verify of a single claim."""
     return verify_signature_sets(

@@ -31,6 +31,7 @@ import numpy as np
 import jax
 import jax.numpy as jnp
 
+from ....utils import metrics
 from ..tpu import curve as TC
 from ..tpu import hash_to_curve as THC
 from ..tpu import limbs as L
@@ -286,11 +287,16 @@ def _common_table(sets):
     return table
 
 
-def verify_signature_sets(sets, seed=None) -> bool:
+def _marshal_batch(sets, seed=None):
+    """Host-side marshalling for one batch: shape bucketing, distinct-
+    message dedup, limb packing (or device-table index gather), weights.
+    Returns the 6-tuple of `verify_device` arguments, or None when a
+    structural check already decides the batch (empty pubkeys / infinity
+    signature -> invalid, no device work)."""
     # host-side structural checks (cheap; device work is all-or-nothing)
     for s in sets:
         if not s.pubkeys or s.signature.point.inf:
-            return False
+            return None
 
     n = len(sets)
     k = max(len(s.pubkeys) for s in sets)
@@ -322,6 +328,7 @@ def verify_signature_sets(sets, seed=None) -> bool:
         # host->device traffic is validator INDICES; limb rows are gathered
         # from the device-resident table. The eager gather feeds the same
         # warm verify_jit executable as the host-packed path.
+        metrics.BLS_GATHER_HITS.inc()
         idx = np.zeros((n_b, k_b), np.int32)
         mask = np.zeros((n_b, k_b), bool)
         for i, s in enumerate(sets):
@@ -335,6 +342,7 @@ def verify_signature_sets(sets, seed=None) -> bool:
             jnp.asarray(mask)[..., None, None], rows, jnp.asarray(_INF_G1)
         )
     else:
+        metrics.BLS_GATHER_MISSES.inc()
         pk = np.broadcast_to(_INF_G1, (n_b, k_b, 3, W)).copy()
         for i, s in enumerate(sets):
             for j, key in enumerate(s.pubkeys):
@@ -349,27 +357,70 @@ def verify_signature_sets(sets, seed=None) -> bool:
     real = np.zeros((n_b,), bool)
     real[:n] = True
 
+    return (
+        jnp.asarray(u),
+        jnp.asarray(h_idx),
+        pk_dev,
+        jnp.asarray(sig),
+        jnp.asarray(scalars),
+        jnp.asarray(real),
+    )
+
+
+def _shard_min_sets() -> int:
+    """Bucketed-batch size at or above which the batch shards across the
+    device mesh (0 disables sharding). Read per call: tests and operators
+    retune it without reimporting."""
+    return int(os.environ.get("LIGHTHOUSE_TPU_SHARD_MIN_SETS", "512"))
+
+
+def _mesh_verifier():
+    """Lazy module-level MeshVerifier (parallel/verify_sharded.py): one
+    per process, so per-device breaker state and compiled shard programs
+    persist across batches."""
+    global _MESH
+    if _MESH is None:
+        from ....parallel.verify_sharded import MeshVerifier
+
+        _MESH = MeshVerifier()
+    return _MESH
+
+
+_MESH = None
+
+
+def dispatch_verify_signature_sets(sets, seed=None):
+    """Async half of `verify_signature_sets`: marshal + enqueue, NO host
+    sync. Returns a zero-dim device bool (materialise with `bool()`), or
+    a plain python bool when a structural check or the monolith/sharded
+    path already decided the batch. The pipeline (crypto/bls/pipeline.py)
+    overlaps the next batch's marshalling with this batch's device work.
+    """
+    args = _marshal_batch(sets, seed=seed)
+    if args is None:
+        return False
+    u, h_idx, pk_dev, sig, scalars, real = args
+
+    n_b = int(real.shape[0])
+    threshold = _shard_min_sets()
+    if threshold and n_b >= threshold and len(jax.devices()) > 1:
+        # Multi-chip hot path: shard the per-set axis over the device
+        # mesh; a chip fault shrinks the mesh over survivors (per-device
+        # breakers) and raises MeshEmpty only when no device is usable --
+        # which the FallbackBackend degrades to the cpu oracle.
+        return _mesh_verifier().verify(
+            (jnp.take(u, h_idx, axis=0), pk_dev, sig, scalars, real)
+        )
     if os.environ.get("LIGHTHOUSE_TPU_MONOLITH") == "1":
         # the monolithic program takes per-set draws (no dedup axis)
-        return bool(
-            verify_jit(
-                jnp.asarray(u[h_idx]),
-                pk_dev,
-                jnp.asarray(sig),
-                jnp.asarray(scalars),
-                jnp.asarray(real),
-            )
+        return verify_jit(
+            jnp.take(u, h_idx, axis=0), pk_dev, sig, scalars, real
         )
-    return bool(
-        verify_device(
-            jnp.asarray(u),
-            jnp.asarray(h_idx),
-            pk_dev,
-            jnp.asarray(sig),
-            jnp.asarray(scalars),
-            jnp.asarray(real),
-        )
-    )
+    return verify_device(u, h_idx, pk_dev, sig, scalars, real)
+
+
+def verify_signature_sets(sets, seed=None) -> bool:
+    return bool(dispatch_verify_signature_sets(sets, seed=seed))
 
 
 @jax.jit

@@ -1,0 +1,248 @@
+"""Asynchronous BLS batch-verification pipeline: futures + double-buffering.
+
+The synchronous hot path blocks the caller for the full device round trip
+of every batch. JAX dispatch is already asynchronous -- a jitted call
+returns a device array immediately and only materialising the VALUE
+blocks -- so the whole pipeline falls out of *not asking for the answer
+yet*: ``submit()`` does the host-side work for a batch (structural
+checks, limb packing or device-table index marshalling, the
+`_field_draws_cached` hash-to-field draws) and enqueues the device
+program, then returns a :class:`VerifyFuture`. While the device chews on
+batch N, the caller (a BeaconProcessor worker) marshals batch N+1 -- the
+overlap the reference gets from rayon worker parallelism
+(beacon_processor/mod.rs), here for free from XLA's async runtime.
+
+Depth is bounded (default 2: the classic double buffer): submitting past
+the bound resolves the oldest in-flight batch first, so host marshalling
+can never run unboundedly ahead of the device. Futures resolve strictly
+in submit order -- resolving future K first resolves 0..K-1, keeping the
+observable result order identical to the synchronous path.
+
+Backends participate at two levels of the same module duck type:
+
+  * ``dispatch_verify_signature_sets(sets, seed=None)`` (jax_tpu): does
+    host marshalling + device enqueue, returns a zero-dim device array
+    (or a plain bool for structural early-exits). True async.
+  * ``verify_signature_sets`` only (cpu, fake, fallback): the pipeline
+    degrades to compute-at-submit; futures still behave identically, so
+    callers never branch on the backend.
+
+Every phase is recorded into an optional resilience ``EventLog`` --
+("pipeline_marshal" / "pipeline_dispatch" / "pipeline_resolve", batch=n)
+-- which is the test surface for the double-buffer overlap contract:
+batch N+1's marshal event landing before batch N's resolve event IS the
+overlap, deterministically.
+"""
+
+from __future__ import annotations
+
+from collections import deque
+
+from ...utils import metrics
+
+
+class PipelineError(RuntimeError):
+    pass
+
+
+_PENDING = "pending"
+_DISPATCHED = "dispatched"
+_RESOLVED = "resolved"
+
+
+class VerifyFuture:
+    """Handle to one submitted batch; ``result()`` blocks (resolving any
+    earlier in-flight batches first) and returns the batch verdict."""
+
+    __slots__ = ("batch_id", "_pipeline", "_state", "_value", "_error")
+
+    def __init__(self, batch_id: int, pipeline: "VerifyPipeline"):
+        self.batch_id = batch_id
+        self._pipeline = pipeline
+        self._state = _PENDING
+        self._value = None
+        self._error = None
+
+    def done(self) -> bool:
+        """True once ``result()`` would return without a device wait
+        (never blocks): either the verdict is resolved locally, or the
+        in-flight device value reports itself ready (``is_ready`` on
+        jax arrays / MeshVerdict), or the backend computed eagerly."""
+        if self._state == _RESOLVED or self._error is not None:
+            return True
+        if self._state != _DISPATCHED:
+            return False
+        ready = getattr(self._value, "is_ready", None)
+        if callable(ready):
+            try:
+                return bool(ready())
+            except Exception:  # noqa: BLE001 -- a dead buffer "is
+                # ready": resolving it surfaces the fault immediately
+                return True
+        return True  # plain bool (eager backend / structural verdict)
+
+    def result(self) -> bool:
+        """The batch verdict. Blocks on the device if still in flight;
+        resolves every earlier submitted batch first (submit order)."""
+        if self._state != _RESOLVED:
+            self._pipeline._resolve_through(self)
+        if self._error is not None:
+            raise self._error
+        return self._value
+
+
+class VerifyPipeline:
+    """Bounded-depth scheduler over the active BLS backend.
+
+    ``backend`` may be a backend module/object or None, in which case the
+    api layer's active backend is consulted at every submit (so
+    ``set_backend`` keeps working mid-process). ``events`` is a
+    resilience EventLog for deterministic phase-ordering assertions.
+    """
+
+    def __init__(self, backend=None, depth: int = 2, events=None):
+        if depth < 1:
+            raise PipelineError("pipeline depth must be >= 1")
+        self._backend = backend
+        self.depth = depth
+        self.events = events
+        self._inflight: deque[VerifyFuture] = deque()
+        self._next_id = 0
+        metrics.BLS_PIPELINE_DEPTH.set(depth)
+
+    # -- introspection -------------------------------------------------------
+
+    def occupancy(self) -> int:
+        return len(self._inflight)
+
+    def _record(self, kind: str, batch: int) -> None:
+        if self.events is not None:
+            self.events.record(kind, batch=batch)
+
+    def _active_backend(self):
+        if self._backend is not None:
+            return self._backend
+        from . import api
+
+        return api._ensure_backend()
+
+    # -- submission ----------------------------------------------------------
+
+    def submit(self, sets, seed: int | None = None) -> VerifyFuture:
+        """Marshal + dispatch one batch; returns its future. Backpressure:
+        at configured depth, the OLDEST in-flight batch is resolved first
+        (its device work is the most likely to have finished)."""
+        sets = list(sets)
+
+        def produce(fut):
+            if not sets:
+                # empty batch: same verdict the sync api pins (False)
+                fut._value, fut._state = False, _RESOLVED
+                return
+            backend = self._active_backend()
+            dispatch = getattr(
+                backend, "dispatch_verify_signature_sets", None
+            )
+            if dispatch is not None:
+                fut._value = dispatch(sets, seed=seed)
+            else:
+                # backend without async dispatch: compute at submit
+                fut._value = bool(
+                    backend.verify_signature_sets(sets, seed=seed)
+                )
+            fut._state = _DISPATCHED
+
+        return self._enqueue(produce)
+
+    def submit_call(self, fn, *args) -> VerifyFuture:
+        """Low-level seat: pipeline ``fn(*args)`` as one batch, where
+        ``fn`` is an async-dispatching device call over pre-marshaled
+        arrays (bench.py drives the measured kernel through this, so the
+        pipeline counters cover it without re-marshalling fixtures)."""
+
+        def produce(fut):
+            fut._value = fn(*args)
+            fut._state = _DISPATCHED
+
+        return self._enqueue(produce)
+
+    def _enqueue(self, produce) -> VerifyFuture:
+        fut = VerifyFuture(self._next_id, self)
+        self._next_id += 1
+        while len(self._inflight) >= self.depth:
+            self._resolve_one()
+        self._record("pipeline_marshal", fut.batch_id)
+        try:
+            produce(fut)
+        except Exception as e:  # noqa: BLE001 -- the future carries the
+            # backend/device fault to result(), exactly where the sync
+            # path would have raised it; nothing is swallowed
+            fut._error, fut._state = e, _DISPATCHED
+        self._record("pipeline_dispatch", fut.batch_id)
+        metrics.BLS_PIPELINE_BATCHES.inc()
+        if fut._state == _RESOLVED:  # structural early-exit: nothing in flight
+            self._record("pipeline_resolve", fut.batch_id)
+            return fut
+        self._inflight.append(fut)
+        occ = len(self._inflight)
+        metrics.BLS_PIPELINE_OCCUPANCY.set(occ)
+        if occ > metrics.BLS_PIPELINE_OCCUPANCY_PEAK.value:
+            metrics.BLS_PIPELINE_OCCUPANCY_PEAK.set(occ)
+        return fut
+
+    # -- resolution ----------------------------------------------------------
+
+    def _resolve_one(self) -> None:
+        if not self._inflight:
+            return
+        fut = self._inflight.popleft()
+        if fut._error is None:
+            # bool() on the device array is THE host sync point: it blocks
+            # until the enqueued program finishes (a plain bool passes
+            # straight through)
+            try:
+                fut._value = bool(fut._value)
+            except Exception as e:  # noqa: BLE001 -- a device fault can
+                # surface at materialisation rather than dispatch; the
+                # future carries it to result() either way
+                fut._error = e
+        fut._state = _RESOLVED
+        self._record("pipeline_resolve", fut.batch_id)
+        metrics.BLS_PIPELINE_OCCUPANCY.set(len(self._inflight))
+
+    def _resolve_through(self, fut: VerifyFuture) -> None:
+        """Resolve in-flight batches oldest-first up to and including
+        `fut` (futures resolve in submit order, never out of it)."""
+        while fut._state != _RESOLVED:
+            if not self._inflight:
+                raise PipelineError(
+                    f"future {fut.batch_id} is not in flight"
+                )
+            self._resolve_one()
+
+    def drain(self) -> None:
+        """Resolve everything in flight (shutdown/idle barrier)."""
+        while self._inflight:
+            self._resolve_one()
+
+
+# -- module-level default (the api.verify_signature_sets_async seat) ---------
+
+_DEFAULT: VerifyPipeline | None = None
+
+
+def default_pipeline() -> VerifyPipeline:
+    global _DEFAULT
+    if _DEFAULT is None:
+        _DEFAULT = VerifyPipeline()
+    return _DEFAULT
+
+
+def configure(**kwargs) -> VerifyPipeline:
+    """Replace the module-level pipeline (tests inject depth/events/
+    backend here, mirroring backends/fallback.configure)."""
+    global _DEFAULT
+    if _DEFAULT is not None:
+        _DEFAULT.drain()
+    _DEFAULT = VerifyPipeline(**kwargs)
+    return _DEFAULT

@@ -936,21 +936,31 @@ class BeaconApi:
 
     def _state_at_slot(self, slot: int):
         """Historical state resolution: authoritative cold path below the
-        split, the state_at_slot hot index above it."""
+        split; above it, the CANONICAL state root from the head state's
+        ring buffer (forwards_state_roots_iter) — never the
+        last-writer-wins state_at_slot chain index, which can name a
+        non-canonical fork's state (hot_cold.py documents exactly that
+        hazard for the restore-point path)."""
+        from ..store.hot_cold import StoreError
+
         store = self.chain.store
         if slot < store.split_slot:
             try:
                 return store.load_cold_state(slot)
-            except KeyError:
+            except KeyError:  # StoreError subclasses KeyError
                 # unreconstructable cold slot (no restore point below, or
                 # a documented state-root gap): this epoch is unavailable,
                 # not the whole response
                 return None
-        from ..store.kv import slot_key
-
-        root = store.get_chain_item(b"state_at_slot:" + slot_key(slot))
-        if root is None:
+        head_state = self.chain.head_state
+        if slot > int(head_state.slot):
             return None
+        try:
+            root, _ = next(
+                iter(store.forwards_state_roots_iter(slot, slot, head_state))
+            )
+        except (StoreError, StopIteration):
+            return None  # outside the hot ring: unavailable, not fatal
         try:
             return store.get_state(root)
         except KeyError:

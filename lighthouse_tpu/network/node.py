@@ -10,8 +10,8 @@ topics and serves the req/resp protocols."""
 from __future__ import annotations
 
 from ..chain.attestation_verification import (
-    batch_verify_aggregates,
-    batch_verify_unaggregated,
+    submit_aggregate_batch,
+    submit_unaggregated_batch,
 )
 from ..chain.beacon_chain import BeaconChain, BlockError
 from ..pool import (
@@ -22,7 +22,7 @@ from ..pool import (
     ObservedBlockProducers,
     OperationPool,
 )
-from ..processor import BeaconProcessor
+from ..processor import BeaconProcessor, DeferredWork
 from ..types import compute_epoch_at_slot, compute_fork_digest
 from .message_bus import MessageBus, topic_name
 from ..chain.sync_committee_verification import (
@@ -502,19 +502,28 @@ class NetworkNode:
             self.slasher_service.accept_block(signed_block)
         self._flush_reprocess(signed_block.message.tree_hash_root())
 
-    def _work_aggregates(self, items) -> None:
-        with self.pools_lock:
-            self._work_aggregates_locked(items)
-
-    def _work_aggregates_locked(self, items) -> None:
+    def _work_aggregates(self, items):
+        """Submit the batch (marshal + device dispatch) under the pools
+        lock, hand the processor a DeferredWork: the worker is free to
+        form the next batch while the device verifies this one."""
         aggs = [a for a, _ in items]
         sources = {id(a): s for a, s in items}
-        verified, rejected = batch_verify_aggregates(
-            self.chain,
-            aggs,
-            self.observed_aggregates,
-            self.observed_aggregators,
-        )
+        with self.pools_lock:
+            pending = submit_aggregate_batch(
+                self.chain,
+                aggs,
+                self.observed_aggregates,
+                self.observed_aggregators,
+            )
+
+        def complete():
+            with self.pools_lock:
+                verified, rejected = pending.complete()
+                self._apply_aggregate_results(verified, rejected, sources)
+
+        return DeferredWork(pending.done, complete)
+
+    def _apply_aggregate_results(self, verified, rejected, sources) -> None:
         for v in verified:
             self.op_pool.insert_attestation(v.signed_aggregate.message.aggregate)
             self.chain.apply_attestation(
@@ -533,16 +542,22 @@ class NetworkNode:
                     agg.tree_hash_root(),
                 )
 
-    def _work_attestations(self, items) -> None:
-        with self.pools_lock:
-            self._work_attestations_locked(items)
-
-    def _work_attestations_locked(self, items) -> None:
+    def _work_attestations(self, items):
         atts = [a for a, _ in items]
         sources = {id(a): s for a, s in items}
-        verified, rejected = batch_verify_unaggregated(
-            self.chain, atts, self.observed_attesters
-        )
+        with self.pools_lock:
+            pending = submit_unaggregated_batch(
+                self.chain, atts, self.observed_attesters
+            )
+
+        def complete():
+            with self.pools_lock:
+                verified, rejected = pending.complete()
+                self._apply_attestation_results(verified, rejected, sources)
+
+        return DeferredWork(pending.done, complete)
+
+    def _apply_attestation_results(self, verified, rejected, sources) -> None:
         for v in verified:
             self.naive_pool.insert(v.attestation)
             self.op_pool.insert_attestation(v.attestation)

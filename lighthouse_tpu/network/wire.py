@@ -584,6 +584,14 @@ class WireBus:
         from .peer_score import PeerScorer
 
         self.scorer = PeerScorer()
+        # relay-path score snapshot: peer_id -> (score, stamp). Scoring a
+        # peer takes the scorer lock and lazily decays every topic, so
+        # the relay loop must not do it per subscriber per message under
+        # the bus lock; scores are recomputed at most once per TTL and
+        # always OUTSIDE the bus lock (penalties surface one TTL late at
+        # worst, which mesh behavior tolerates)
+        self.score_ttl_s = 1.0
+        self._score_cache: dict[str, tuple[float, float]] = {}
         self._lock = threading.Lock()
         self._server = None
         # observability for mesh/limiter tests
@@ -851,6 +859,7 @@ class WireBus:
 
     def _drop_peer(self, peer_id: str) -> None:
         self.scorer.forget(peer_id)
+        self._score_cache.pop(peer_id, None)
         with self._lock:
             self._peers.pop(peer_id, None)
             conn = self._conns.pop(peer_id, None)
@@ -891,6 +900,30 @@ class WireBus:
                 self._seen.popitem(last=False)
             return True
 
+    def _cached_scores(self, peer_ids) -> dict[str, float]:
+        """Fresh-enough scores for `peer_ids`, recomputed at most once
+        per `score_ttl_s` per peer. MUST be called outside the bus lock:
+        a cache miss takes the scorer lock and runs lazy decay over the
+        peer's topics."""
+        now = time.monotonic()
+        out = {}
+        for pid in peer_ids:
+            hit = self._score_cache.get(pid)
+            if hit is None or now - hit[1] >= self.score_ttl_s:
+                hit = (self.scorer.score(pid), now)
+                self._score_cache[pid] = hit
+            out[pid] = hit[0]
+        if len(self._score_cache) > 4 * max(len(out), 64):
+            # forget snapshot entries for long-gone peers
+            stale = [
+                p
+                for p, (_, stamp) in list(self._score_cache.items())
+                if now - stamp >= self.score_ttl_s
+            ]
+            for p in stale:
+                self._score_cache.pop(p, None)
+        return out
+
     def _gossip_send(self, topic: str, data: bytes, exclude: str | None) -> int:
         """Eager-push to the topic MESH over persistent connections (the
         gossipsub relay; flood only if the mesh is empty but subscribers
@@ -902,11 +935,24 @@ class WireBus:
             + self.peer_id.encode()
             + data
         )
+        # snapshot scores OUTSIDE the bus lock (relay cost was
+        # O(subscribers x their topics) per message under BOTH locks)
+        with self._lock:
+            candidates = set(self._mesh.get(topic, ())) | {
+                pid
+                for pid, info in self._peers.items()
+                if topic in info["topics"]
+            }
+        scores = self._cached_scores(candidates)
         with self._lock:
             mesh = set(self._mesh.get(topic, ()))
             # behavioral eviction: peers scored below the prune threshold
             # leave the mesh (and get a PRUNE) before this relay
-            evict = {p for p in mesh if self.scorer.should_prune(p)}
+            evict = {
+                p
+                for p in mesh
+                if scores.get(p, 0.0) < self.scorer.prune_threshold
+            }
             if evict:
                 self._mesh[topic] = mesh - evict
                 mesh -= evict
@@ -917,7 +963,7 @@ class WireBus:
                 for pid, info in self._peers.items()
                 if topic in info["topics"]
                 # gossip_threshold: stop relaying TO low-score peers
-                and self.scorer.score(pid) >= self.scorer.gossip_threshold
+                and scores.get(pid, 0.0) >= self.scorer.gossip_threshold
             }
             # backfill the mesh after eviction (every other removal path
             # re-grafts; eviction must not strand the mesh below degree)

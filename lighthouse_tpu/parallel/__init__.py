@@ -6,6 +6,10 @@ with XLA collectives on ICI; the p2p fabric stays host-side.
 """
 
 from .verify_sharded import (  # noqa: F401
+    DeviceExecutor,
+    DeviceProber,
+    MeshEmpty,
+    MeshVerifier,
     make_sharded_verify,
     sets_mesh,
 )

@@ -1,4 +1,8 @@
 """Work scheduling (reference beacon_node/network/src/beacon_processor):
 prioritized bounded queues forming TPU-sized verification batches."""
 
-from .beacon_processor import BeaconProcessor, WorkQueue  # noqa: F401
+from .beacon_processor import (  # noqa: F401
+    BeaconProcessor,
+    DeferredWork,
+    WorkQueue,
+)

@@ -25,6 +25,25 @@ from dataclasses import dataclass, field
 
 
 @dataclass
+class DeferredWork:
+    """A handler's deferred completion: the batch's signature verdict is
+    in flight on the device. ``done()`` polls without blocking;
+    ``complete()`` resolves and finishes the batch. Handlers return one
+    of these (or any object with the same two callables, e.g.
+    chain.attestation_verification.PendingBatch) to free their worker
+    while the device computes."""
+
+    done: object
+    complete: object
+
+
+def _is_deferred(out) -> bool:
+    return callable(getattr(out, "done", None)) and callable(
+        getattr(out, "complete", None)
+    )
+
+
+@dataclass
 class WorkQueue:
     name: str
     max_len: int
@@ -86,9 +105,14 @@ class BeaconProcessor:
         max_batch: int = 1024,
         max_workers: int = 1,
         journal: bool = False,
+        max_inflight: int = 2,
     ):
         """handlers: name -> callable(list_of_items) for batch queues or
-        callable(item) for singleton queues.
+        callable(item) for singleton queues. A handler may return a
+        DeferredWork(-shaped) object: the verdict is then in flight on
+        the device and the worker moves on to the next claim (marshal
+        batch N+1 while N computes); completions resolve in submit order,
+        bounded by `max_inflight` (the classic double buffer at 2).
 
         `max_workers` bounds the worker pool (mod.rs:85-115 max_workers /
         current_workers accounting): each worker claims the highest-
@@ -99,6 +123,9 @@ class BeaconProcessor:
         test surface (mod.rs:1052-1061 work journal)."""
         self.max_batch = max_batch
         self.max_workers = max(1, max_workers)
+        self.max_inflight = max(1, max_inflight)
+        # FIFO of (queue_name, n_items, deferred) awaiting resolution
+        self._deferred: deque[tuple[str, int, object]] = deque()
         self.journal: list[tuple[str, int]] | None = [] if journal else None
         self.queues = {
             "chain_segment": WorkQueue("chain_segment", 64),
@@ -164,13 +191,25 @@ class BeaconProcessor:
         return None, None
 
     def _execute(self, name: str, items) -> None:
+        # backpressure BEFORE dispatching more device work: at the
+        # in-flight bound, the oldest verdict resolves first, so there
+        # are never more than max_inflight submitted-unresolved batches.
+        # Only the batched (deferrable) lanes pay this wait -- a block
+        # import must never stall behind an attestation verdict.
+        while name in self.batched:
+            with self._lock:
+                full = len(self._deferred) >= self.max_inflight
+            if not full:
+                break
+            self._complete_deferred(block=True)
         handler = self.handlers.get(name)
+        out = None
         try:
             if handler is not None:
                 if name in self.batched:
-                    handler(items)
+                    out = handler(items)
                 else:
-                    handler(items[0])
+                    out = handler(items[0])
         # lint: allow[broad-except] -- worker survival boundary: handlers
         # are arbitrary application callbacks, so the exception type is
         # unknowable here; the failure is counted per-queue and surfaced
@@ -178,22 +217,55 @@ class BeaconProcessor:
         except Exception as exc:  # noqa: BLE001 -- a poisoned work item
             # must not kill its worker (mod.rs workers are respawned per
             # task; here the thread persists, so survive and count)
+            self._count_error(name, exc)
+        if _is_deferred(out):
+            # verdict in flight: account at completion
             with self._lock:
-                self.handler_errors[name] = (
-                    self.handler_errors.get(name, 0) + 1
-                )
-                self.last_error = f"{name}: {type(exc).__name__}: {exc}"
+                self._deferred.append((name, len(items), out))
+            return
         with self._lock:
             self.processed[name] += len(items)
 
+    def _count_error(self, name: str, exc: BaseException) -> None:
+        with self._lock:
+            self.handler_errors[name] = self.handler_errors.get(name, 0) + 1
+            self.last_error = f"{name}: {type(exc).__name__}: {exc}"
+
+    def _complete_deferred(self, block: bool) -> bool:
+        """Resolve the OLDEST deferred batch (submit order). With
+        block=False only if its device work already finished. Returns
+        True if one completed."""
+        with self._lock:
+            if not self._deferred:
+                return False
+            if not block and not self._deferred[0][2].done():
+                return False
+            name, n, work = self._deferred.popleft()
+        try:
+            work.complete()
+        # lint: allow[broad-except] -- same worker survival boundary as
+        # _execute: completion runs arbitrary application callbacks
+        except Exception as exc:  # noqa: BLE001 -- a poisoned completion
+            # must not kill its worker; counted exactly like a handler
+            # failure
+            self._count_error(name, exc)
+        with self._lock:
+            self.processed[name] += n
+        return True
+
     def run_until_idle(self) -> int:
-        """Drain all queues in priority order on the calling thread;
-        returns work-item count (synchronous mode: tests, simulator)."""
+        """Drain all queues in priority order on the calling thread
+        (resolving deferred batch verdicts as they land); returns
+        work-item count (synchronous mode: tests, simulator)."""
         done = 0
         while True:
+            while self._complete_deferred(block=False):
+                pass
             with self._lock:
                 name, items = self._next_work()
             if name is None:
+                if self._complete_deferred(block=True):
+                    continue
                 return done
             self._execute(name, items)
             done += len(items)
@@ -213,13 +285,20 @@ class BeaconProcessor:
                 with self._lock:
                     name, items = self._next_work()
                     while name is None:
+                        if self._deferred:
+                            break  # resolve a deferred verdict instead
                         if self._stop.is_set():
                             return
                         self._work_available.wait(0.05)
                         name, items = self._next_work()
                     self._busy_workers += 1
                 try:
-                    self._execute(name, items)
+                    if name is None:
+                        # queues empty, verdicts in flight: resolving the
+                        # oldest IS this worker's work
+                        self._complete_deferred(block=True)
+                    else:
+                        self._execute(name, items)
                 finally:
                     with self._lock:
                         self._busy_workers -= 1
@@ -240,8 +319,10 @@ class BeaconProcessor:
         deadline = _time.monotonic() + timeout
         while _time.monotonic() < deadline:
             with self._lock:
-                if self._busy_workers == 0 and not any(
-                    len(q) for q in self.queues.values()
+                if (
+                    self._busy_workers == 0
+                    and not self._deferred
+                    and not any(len(q) for q in self.queues.values())
                 ):
                     return True
             _time.sleep(0.002)
@@ -254,3 +335,7 @@ class BeaconProcessor:
         for t in self._threads:
             t.join()
         self._threads = []
+        # verdicts still in flight resolve on the stopping thread: a
+        # submitted batch is never abandoned half-verified
+        while self._complete_deferred(block=True):
+            pass

@@ -189,7 +189,10 @@ ATTN_BATCH_SETUP_TIMES = REGISTRY.histogram(
 )
 ATTN_BATCH_VERIFY_TIMES = REGISTRY.histogram(
     "beacon_attestation_batch_verify_seconds",
-    "Gossip attestation batch: backend signature verify",
+    "Gossip attestation batch: worker-visible wait for the signature "
+    "verdict (+bisection); under the async pipeline device compute "
+    "overlaps the next batch's marshalling, so this is residual wait, "
+    "not raw device time",
 )
 BLOCKS_IMPORTED = REGISTRY.counter(
     "beacon_blocks_imported_total", "Blocks successfully imported"
@@ -229,4 +232,50 @@ ENDPOINT_HEALTH = REGISTRY.labeled_gauge(
     "resilience_endpoint_health_score",
     "Recent-outcome health score per tracked endpoint (0..1)",
     label="endpoint",
+)
+
+# -- the verification-pipeline metric family (crypto/bls/pipeline.py,
+# parallel/verify_sharded.py, chain/attestation_verification.py) -------------
+# Async pipeline depth/occupancy, device-gather hit rate, shard-mesh size,
+# and bisection cost: the observable surface of the pipelined hot path.
+
+BLS_PIPELINE_DEPTH = REGISTRY.gauge(
+    "bls_pipeline_depth",
+    "Configured max in-flight batches of the async verify pipeline",
+)
+BLS_PIPELINE_OCCUPANCY = REGISTRY.gauge(
+    "bls_pipeline_occupancy",
+    "Batches currently dispatched to device and not yet resolved",
+)
+BLS_PIPELINE_OCCUPANCY_PEAK = REGISTRY.gauge(
+    "bls_pipeline_occupancy_peak",
+    "High-water mark of in-flight batches since process start",
+)
+BLS_PIPELINE_BATCHES = REGISTRY.counter(
+    "bls_pipeline_batches_total",
+    "Batches submitted through verify_signature_sets_async",
+)
+BLS_GATHER_HITS = REGISTRY.counter(
+    "bls_device_gather_batches_total",
+    "Batches whose pubkeys were gathered from the device-resident table",
+)
+BLS_GATHER_MISSES = REGISTRY.counter(
+    "bls_host_packed_batches_total",
+    "Batches that fell back to per-key host limb packing",
+)
+BLS_SHARD_MESH_SIZE = REGISTRY.gauge(
+    "bls_shard_mesh_devices",
+    "Devices in the shard mesh used by the last sharded batch",
+)
+BLS_SHARDED_BATCHES = REGISTRY.counter(
+    "bls_sharded_batches_total",
+    "Batches verified across the multi-chip shard mesh",
+)
+BLS_MESH_SHRINKS = REGISTRY.counter(
+    "bls_shard_mesh_shrinks_total",
+    "Times a chip fault re-sharded a batch over the surviving devices",
+)
+BLS_BISECTION_CALLS = REGISTRY.counter(
+    "bls_bisection_backend_calls_total",
+    "Extra backend calls spent isolating invalid sets by bisection",
 )

@@ -1,0 +1,644 @@
+"""The pipelined verification hot path: async futures API
+(crypto/bls/pipeline.py), bisection batch-failure fallback
+(chain/attestation_verification.py), BeaconProcessor deferred-work
+scheduling, and the MeshVerifier's per-device breaker mechanics
+(parallel/verify_sharded.py) on fake devices.
+
+Everything here is deterministic and compiles NO XLA programs: device
+behavior is stubbed at the pipeline/executor seams (real-kernel mesh
+coverage lives in test_multichip.py; real-crypto pipeline parity rides
+the cpu oracle backend).
+"""
+
+import math
+from types import SimpleNamespace
+
+import pytest
+
+from lighthouse_tpu.chain.attestation_verification import (
+    bisect_batch_failures,
+)
+from lighthouse_tpu.crypto.bls import (
+    SecretKey,
+    SignatureSet,
+    set_backend,
+    verify_signature_sets,
+    verify_signature_sets_async,
+)
+from lighthouse_tpu.crypto.bls import pipeline as P
+from lighthouse_tpu.processor import BeaconProcessor, DeferredWork
+from lighthouse_tpu.resilience.primitives import CircuitBreaker, EventLog
+from lighthouse_tpu.utils import metrics as M
+
+
+@pytest.fixture(autouse=True)
+def _fake_backend_and_fresh_pipeline():
+    set_backend("fake")
+    yield
+    P.configure()  # drop any injected pipeline state
+    set_backend("jax_tpu")
+
+
+def _mkset(i: int) -> SignatureSet:
+    msg = (9000 + i).to_bytes(32, "little")
+    sk = SecretKey(41 + i)
+    return SignatureSet.single_pubkey(sk.sign(msg), sk.public_key(), msg)
+
+
+class _LazyVerdict:
+    """Stands in for a zero-dim device array: materialisation is
+    observable (and counted), like bool() on an in-flight jax array."""
+
+    def __init__(self, value: bool, log: list, batch: int):
+        self.value, self.log, self.batch = value, log, batch
+
+    def __bool__(self):
+        self.log.append(("materialize", self.batch))
+        return self.value
+
+
+class _AsyncStubBackend:
+    """Module-duck-typed backend with an async dispatch hook: dispatch
+    returns immediately (recording the call), the verdict materialises
+    only when the pipeline resolves the future."""
+
+    def __init__(self, verdicts=None):
+        self.log = []
+        self.batches = 0
+        self.verdicts = verdicts
+
+    def dispatch_verify_signature_sets(self, sets, seed=None):
+        n = self.batches
+        self.batches += 1
+        self.log.append(("dispatch", n))
+        v = True if self.verdicts is None else self.verdicts[n]
+        return _LazyVerdict(v, self.log, n)
+
+    def verify_signature_sets(self, sets, seed=None):
+        return bool(self.dispatch_verify_signature_sets(sets, seed=seed))
+
+
+class TestVerifyPipeline:
+    def test_futures_resolve_in_submit_order(self):
+        ev = EventLog()
+        pipe = P.VerifyPipeline(backend=_AsyncStubBackend(), depth=4, events=ev)
+        futs = [pipe.submit([_mkset(i)]) for i in range(3)]
+        # asking for the LAST future first still resolves 0, 1, 2 in order
+        assert futs[2].result() is True
+        resolves = [e for e in ev.events if e[0] == "pipeline_resolve"]
+        assert [dict(e[1:])["batch"] for e in resolves] == [0, 1, 2]
+        assert all(f.done() for f in futs)
+
+    def test_double_buffer_overlap_event_ordering(self):
+        """THE overlap contract: batch 1 is marshalled + dispatched
+        while batch 0's device verdict is still in flight -- visible as
+        marshal(1) strictly between dispatch(0) and resolve(0)."""
+        ev = EventLog()
+        backend = _AsyncStubBackend()
+        pipe = P.VerifyPipeline(backend=backend, depth=2, events=ev)
+        f0 = pipe.submit([_mkset(0)])
+        f1 = pipe.submit([_mkset(1)])
+        assert f0.result() and f1.result()
+        kinds = [(e[0], dict(e[1:])["batch"]) for e in ev.events]
+        assert kinds.index(("pipeline_marshal", 1)) < kinds.index(
+            ("pipeline_resolve", 0)
+        )
+        assert kinds.index(("pipeline_dispatch", 0)) < kinds.index(
+            ("pipeline_marshal", 1)
+        )
+        # and the device verdict materialised only at resolve time
+        assert backend.log == [
+            ("dispatch", 0),
+            ("dispatch", 1),
+            ("materialize", 0),
+            ("materialize", 1),
+        ]
+
+    def test_depth_bound_applies_backpressure(self):
+        backend = _AsyncStubBackend()
+        pipe = P.VerifyPipeline(backend=backend, depth=2)
+        for i in range(5):
+            pipe.submit([_mkset(i)])
+            assert pipe.occupancy() <= 2
+        # submitting batch 2 must have resolved batch 0 first (oldest)
+        assert ("materialize", 0) in backend.log
+        assert backend.log.index(("dispatch", 2)) > backend.log.index(
+            ("materialize", 0)
+        )
+        pipe.drain()
+        assert pipe.occupancy() == 0
+        assert M.BLS_PIPELINE_OCCUPANCY_PEAK.value >= 2
+
+    def test_async_matches_sync_verdicts(self):
+        backend = _AsyncStubBackend(verdicts=[True, False, True])
+        pipe = P.VerifyPipeline(backend=backend, depth=2)
+        got = [pipe.submit([_mkset(i)]).result() for i in range(3)]
+        assert got == [True, False, True]
+
+    def test_empty_batch_resolves_false_immediately(self):
+        fut = verify_signature_sets_async([])
+        assert fut.done() and fut.result() is False
+        assert verify_signature_sets([]) is False
+
+    def test_backend_without_dispatch_hook_degrades_to_eager(self):
+        # the active backend is 'fake' (no dispatch hook): futures still
+        # come back and agree with the sync path
+        s = _mkset(1)
+        fut = verify_signature_sets_async([s])
+        assert fut.result() is verify_signature_sets([s]) is True
+
+    def test_dispatch_exception_surfaces_at_result(self):
+        class Boom:
+            def dispatch_verify_signature_sets(self, sets, seed=None):
+                raise ConnectionError("chip fell over")
+
+        pipe = P.VerifyPipeline(backend=Boom(), depth=2)
+        fut = pipe.submit([_mkset(0)])
+        with pytest.raises(ConnectionError, match="chip fell over"):
+            fut.result()
+
+    def test_cpu_oracle_parity_through_pipeline(self):
+        """Real crypto: the async path returns exactly the sync verdict
+        for a valid and an invalid set on the cpu oracle backend."""
+        set_backend("cpu")
+        good = _mkset(3)
+        bad = SignatureSet.single_pubkey(
+            good.signature, good.pubkeys[0], b"\x13" * 32
+        )
+        assert verify_signature_sets_async([good]).result() is True
+        assert verify_signature_sets_async([bad]).result() is False
+
+
+class TestBisection:
+    def _run(self, n, bad_idx):
+        items = [SimpleNamespace(i=i, bad=(i in bad_idx)) for i in range(n)]
+        calls = [0]
+
+        def verify(sets):
+            calls[0] += 1
+            return not any(s.bad for s in sets)
+
+        ok, bad = bisect_batch_failures(items, lambda it: [it], verify)
+        assert sorted(x.i for x in bad) == sorted(bad_idx)
+        assert sorted(x.i for x in ok) == sorted(
+            set(range(n)) - set(bad_idx)
+        )
+        return calls[0]
+
+    def test_one_bad_in_1024_costs_at_most_11_calls(self):
+        """The acceptance bound: ceil(log2 1024) + 1 = 11 additional
+        backend calls, vs 1024 for the per-item fallback."""
+        for pos in (0, 17, 511, 512, 1023):
+            assert self._run(1024, [pos]) <= 11
+
+    def test_k_bad_costs_k_log_n(self):
+        for n, bads in [
+            (1024, [3, 700]),
+            (1024, [1, 2, 3, 4]),
+            (256, [250, 251]),
+            (7, [2]),
+            (2, [0, 1]),
+            (16, list(range(16))),
+        ]:
+            calls = self._run(n, bads)
+            bound = len(bads) * (math.ceil(math.log2(n)) + 1)
+            assert calls <= bound, (n, bads, calls, bound)
+
+    def test_counter_increments(self):
+        before = M.BLS_BISECTION_CALLS.value
+        self._run(64, [5])
+        assert M.BLS_BISECTION_CALLS.value > before
+
+    def test_single_item_batch_no_extra_calls(self):
+        assert self._run(1, [0]) == 0
+
+
+class TestProcessorDeferredWork:
+    def _deferred_handler(self, log, ready):
+        def handler(items):
+            n = len(items)
+            batch = len([e for e in log if e[0] == "submit"])
+            log.append(("submit", batch, n))
+            return DeferredWork(
+                done=lambda: ready(),
+                complete=lambda: log.append(("complete", batch, n)),
+            )
+
+        return handler
+
+    def test_completions_resolve_in_submit_order(self):
+        log = []
+        bp = BeaconProcessor(
+            handlers={
+                "gossip_attestation": self._deferred_handler(
+                    log, lambda: False  # never "done": forces ordered
+                )                       # blocking resolution at idle
+            },
+            max_batch=4,
+            max_inflight=2,
+        )
+        for i in range(12):
+            bp.submit("gossip_attestation", i)
+        bp.run_until_idle()
+        submits = [e[1] for e in log if e[0] == "submit"]
+        completes = [e[1] for e in log if e[0] == "complete"]
+        assert submits == sorted(submits)
+        assert completes == submits  # FIFO, none lost
+        assert bp.processed["gossip_attestation"] == 12
+
+    def test_max_inflight_bounds_overlap(self):
+        """Never more than max_inflight submitted-but-unresolved batches:
+        the processor is the double buffer's second half."""
+        log = []
+        bp = BeaconProcessor(
+            handlers={
+                "gossip_attestation": self._deferred_handler(
+                    log, lambda: False
+                )
+            },
+            max_batch=2,
+            max_inflight=2,
+        )
+        for i in range(10):
+            bp.submit("gossip_attestation", i)
+        bp.run_until_idle()
+        inflight = peak = 0
+        for e in log:
+            inflight += 1 if e[0] == "submit" else -1
+            peak = max(peak, inflight)
+        assert peak == 2  # overlap happens, bounded at the buffer depth
+        assert bp.processed["gossip_attestation"] == 10
+
+    def test_worker_pool_drains_deferred(self):
+        log = []
+        bp = BeaconProcessor(
+            handlers={
+                "gossip_attestation": self._deferred_handler(
+                    log, lambda: True
+                )
+            },
+            max_batch=4,
+            max_workers=2,
+        )
+        bp.start()
+        try:
+            for i in range(8):
+                bp.submit("gossip_attestation", i)
+            assert bp.wait_idle(5.0)
+        finally:
+            bp.stop()
+        assert bp.processed["gossip_attestation"] == 8
+        assert [e[1] for e in log if e[0] == "complete"] == [0, 1]
+
+    def test_failing_completion_counted_not_fatal(self):
+        def handler(items):
+            return DeferredWork(
+                done=lambda: True,
+                complete=lambda: (_ for _ in ()).throw(
+                    ValueError("poisoned completion")
+                ),
+            )
+
+        bp = BeaconProcessor(handlers={"gossip_attestation": handler})
+        bp.submit("gossip_attestation", "a")
+        bp.run_until_idle()
+        assert bp.handler_errors["gossip_attestation"] == 1
+        assert "poisoned completion" in bp.last_error
+        assert bp.processed["gossip_attestation"] == 1
+
+
+# -- MeshVerifier mechanics on fake devices (no jax, no compiles) ------------
+
+
+class _FakeExec:
+    """Executor whose chips can be marked dead: running a mesh that
+    includes a dead chip raises, mirroring a real collective failure."""
+
+    def __init__(self, dead=()):
+        self.dead = set(dead)
+        self.runs = []
+
+    def run(self, fn, args, devices):
+        self.runs.append([d.id for d in devices])
+        if any(d.id in self.dead for d in devices):
+            raise ConnectionError("ICI link down")
+        return True
+
+
+class _FakeProber:
+    def __init__(self, execu):
+        self.execu = execu
+        self.probed = []
+
+    def probe(self, device):
+        self.probed.append(device.id)
+        return device.id not in self.execu.dead
+
+
+def _mesh_verifier(n_dev=8, dead=(), denied_budget=8, events=None):
+    from lighthouse_tpu.parallel import MeshVerifier
+
+    devices = [SimpleNamespace(id=i) for i in range(n_dev)]
+    execu = _FakeExec(dead)
+    mv = MeshVerifier(
+        devices=devices,
+        events=events,
+        executor=execu,
+        prober=_FakeProber(execu),
+        program_factory=lambda devs: "sharded-program",
+        breaker_factory=lambda d: CircuitBreaker(
+            failure_threshold=1,
+            denied_budget=denied_budget,
+            half_open_probes=1,
+            name=f"bls_mesh/{d.id}",
+            events=events,
+        ),
+    )
+    return mv, execu
+
+
+_ARGS = (None, None, None, None, SimpleNamespace(shape=(64,)))
+
+
+class TestMeshVerifierMechanics:
+    def test_full_mesh_when_healthy(self):
+        mv, execu = _mesh_verifier(8)
+        verdict = mv.verify(_ARGS)
+        assert verdict.is_ready() and bool(verdict) is True
+        assert execu.runs == [[0, 1, 2, 3, 4, 5, 6, 7]]
+        assert M.BLS_SHARD_MESH_SIZE.value == 8
+
+    def test_chip_fault_reshards_over_survivors(self):
+        ev = EventLog()
+        mv, execu = _mesh_verifier(8, dead={3}, events=ev)
+        assert bool(mv.verify(_ARGS)) is True
+        # first attempt on 8, re-shard to the 4 healthiest survivors
+        assert execu.runs[0] == [0, 1, 2, 3, 4, 5, 6, 7]
+        assert execu.runs[1] == [0, 1, 2, 4]
+        assert mv.breakers[3].state == CircuitBreaker.OPEN
+        assert "mesh_shrink" in ev.kinds() and "mesh_verify" in ev.kinds()
+
+    def test_cascading_faults_shrink_to_one(self):
+        mv, execu = _mesh_verifier(4, dead={0, 1, 2})
+        assert bool(mv.verify(_ARGS)) is True
+        # 4 -> survivors {3}: mesh of one (the single-chip path)
+        assert execu.runs[-1] == [3]
+
+    def test_mesh_empty_raises_connectionerror(self):
+        from lighthouse_tpu.parallel import MeshEmpty
+
+        mv, execu = _mesh_verifier(2, dead={0, 1})
+        with pytest.raises(MeshEmpty):
+            mv.verify(_ARGS)
+        assert isinstance(MeshEmpty("x"), ConnectionError)
+
+    def test_mesh_empty_degrades_fallback_backend_to_oracle(self):
+        """Only an EMPTY mesh trips the whole backend to the cpu oracle:
+        FallbackBackend treats MeshEmpty as a primary fault."""
+        from lighthouse_tpu.crypto.bls.backends.fallback import (
+            FallbackBackend,
+        )
+        from lighthouse_tpu.parallel import MeshEmpty
+
+        class DeadMeshPrimary:
+            def verify_signature_sets(self, sets, seed=None):
+                raise MeshEmpty("no devices")
+
+        class Oracle:
+            def __init__(self):
+                self.calls = 0
+
+            def verify_signature_sets(self, sets, seed=None):
+                self.calls += 1
+                return True
+
+        oracle = Oracle()
+        fb = FallbackBackend(primary=DeadMeshPrimary(), fallback=oracle)
+        assert fb.verify_signature_sets([_mkset(0)]) is True
+        assert oracle.calls == 1
+
+    def test_lost_chip_reprobes_half_open_and_rejoins(self):
+        mv, execu = _mesh_verifier(2, dead={0}, denied_budget=2)
+        assert bool(mv.verify(_ARGS)) is True  # fault -> survivor mesh [1]
+        assert mv.breakers[0].state == CircuitBreaker.OPEN
+        execu.dead.clear()  # the chip comes back
+        assert bool(mv.verify(_ARGS)) is True  # denied 1/2: still skipped
+        assert execu.runs[-1] == [1]
+        assert bool(mv.verify(_ARGS)) is True  # matured: half-open probe
+        assert execu.runs[-1] == [1, 0]  # recovered chip re-probed in-mesh
+        assert mv.breakers[0].state == CircuitBreaker.CLOSED
+        assert bool(mv.verify(_ARGS)) is True
+        assert execu.runs[-1] == [0, 1]  # back in its priority seat
+
+    def test_matured_probe_gets_a_seat_even_when_mesh_is_full(self):
+        """A recovered chip must not be starved of its probe seat when
+        the closed devices already fill the pow2 mesh: it swaps into a
+        tail seat, proves itself, and the mesh regrows once every chip
+        is back."""
+        from lighthouse_tpu.parallel import MeshVerifier
+
+        devices = [SimpleNamespace(id=i) for i in range(8)]
+        execu = _FakeExec({6, 7})
+        budgets = {6: 3, 7: 1}
+        mv = MeshVerifier(
+            devices=devices,
+            executor=execu,
+            prober=_FakeProber(execu),
+            program_factory=lambda devs: "prog",
+            breaker_factory=lambda d: CircuitBreaker(
+                failure_threshold=1,
+                denied_budget=budgets.get(d.id, 8),
+                half_open_probes=1,
+            ),
+        )
+        assert bool(mv.verify(_ARGS)) is True  # 8 -> fault -> 4 closed
+        assert execu.runs[-1] == [0, 1, 2, 3]
+        execu.dead.clear()
+        # chip 7 matures first (budget 1) while chip 6 stays open: six
+        # closed chips fill the 4-seat mesh on their own, so the probe
+        # must SWAP into a tail seat rather than burn its slot
+        assert bool(mv.verify(_ARGS)) is True
+        assert 7 in execu.runs[-1] and len(execu.runs[-1]) == 4
+        assert mv.breakers[7].state == CircuitBreaker.CLOSED
+        # chip 6 matures later; once probed back in, the mesh regrows
+        for _ in range(6):
+            if mv.breakers[6].state == CircuitBreaker.CLOSED:
+                break
+            assert bool(mv.verify(_ARGS)) is True
+        assert mv.breakers[6].state == CircuitBreaker.CLOSED
+        assert bool(mv.verify(_ARGS)) is True
+        assert execu.runs[-1] == [0, 1, 2, 3, 4, 5, 6, 7]
+
+    def test_fault_at_materialization_reshards(self):
+        """JAX surfaces execution faults at bool()-time, not dispatch:
+        the breaker/re-shard path must live there too."""
+
+        class LazyBoom:
+            def __init__(self):
+                self.ready_polls = 0
+
+            def is_ready(self):
+                self.ready_polls += 1
+                return True
+
+            def block_until_ready(self):
+                raise ConnectionError("chip died mid-execution")
+
+        class LazyExec:
+            """First run returns a deferred value that dies when
+            materialised; reruns succeed."""
+
+            def __init__(self):
+                self.runs = []
+                self.dead = {1}
+
+            def run(self, fn, args, devices):
+                self.runs.append([d.id for d in devices])
+                if len(self.runs) == 1:
+                    return LazyBoom()
+                return True
+
+        from lighthouse_tpu.parallel import MeshVerifier
+
+        devices = [SimpleNamespace(id=i) for i in range(2)]
+        execu = LazyExec()
+        mv = MeshVerifier(
+            devices=devices,
+            executor=execu,
+            prober=SimpleNamespace(probe=lambda d: d.id not in execu.dead),
+            program_factory=lambda devs: "prog",
+            breaker_factory=lambda d: CircuitBreaker(
+                failure_threshold=1, denied_budget=8, half_open_probes=1
+            ),
+        )
+        verdict = mv.verify(_ARGS)  # dispatch succeeds...
+        assert execu.runs == [[0, 1]]
+        assert bool(verdict) is True  # ...fault surfaces HERE -> re-shard
+        assert execu.runs[-1] == [0]
+        assert mv.breakers[1].state == CircuitBreaker.OPEN
+
+    def test_unattributable_fault_charges_all_participants(self):
+        mv, execu = _mesh_verifier(2)
+
+        class CompileBoom:
+            def run(self, fn, args, devices):
+                raise RuntimeError("XLA compile error")
+
+        mv.executor = CompileBoom()
+        mv.prober = SimpleNamespace(probe=lambda d: True)  # all alive
+        from lighthouse_tpu.parallel import MeshEmpty
+
+        with pytest.raises(MeshEmpty):
+            mv.verify(_ARGS)
+        assert all(
+            b.state == CircuitBreaker.OPEN for b in mv.breakers.values()
+        )
+
+    def test_mesh_never_exceeds_batch(self):
+        mv, execu = _mesh_verifier(8)
+        args = (None, None, None, None, SimpleNamespace(shape=(4,)))
+        mv.verify(args)
+        assert execu.runs[0] == [0, 1, 2, 3]  # 4 sets: mesh capped at 4
+
+
+class TestShardRouting:
+    def test_big_batches_route_to_the_mesh(self, monkeypatch):
+        """Above the threshold, jax_tpu.dispatch hands the marshaled
+        batch to the module MeshVerifier instead of the local kernel."""
+        import numpy as np
+
+        from lighthouse_tpu.crypto.bls.backends import jax_tpu
+
+        calls = []
+
+        class StubMesh:
+            def verify(self, args):
+                calls.append(int(args[-1].shape[0]))
+                return True
+
+        monkeypatch.setenv("LIGHTHOUSE_TPU_SHARD_MIN_SETS", "4")
+        monkeypatch.setattr(jax_tpu, "_MESH", StubMesh())
+        sets = [_mkset(i) for i in range(4)]
+        assert jax_tpu.verify_signature_sets(sets, seed=3) is True
+        assert calls == [4]
+
+    def test_threshold_zero_disables_sharding(self, monkeypatch):
+        from lighthouse_tpu.crypto.bls.backends import jax_tpu
+
+        monkeypatch.setenv("LIGHTHOUSE_TPU_SHARD_MIN_SETS", "0")
+        assert jax_tpu._shard_min_sets() == 0
+
+
+class TestSatelliteFixes:
+    def test_light_client_rejects_signature_not_after_attested(self):
+        """Spec slot ordering: sig_slot > attested_slot (ADVICE r5). An
+        equal-slot update must be rejected BEFORE signature checks."""
+        from lighthouse_tpu.chain.light_client import (
+            LightClientError,
+            LightClientStore,
+        )
+
+        store = LightClientStore.__new__(LightClientStore)
+        update = SimpleNamespace(
+            sync_aggregate=SimpleNamespace(sync_committee_bits=[1] * 32),
+            signature_slot=40,
+            attested_header=SimpleNamespace(slot=40),
+            finalized_header=SimpleNamespace(slot=32),
+            finality_branch=[bytes(32)] * 6,
+            next_sync_committee_branch=[bytes(32)] * 5,
+        )
+        with pytest.raises(LightClientError, match="not after attested"):
+            store.process_spec_update(update, current_slot=41)
+
+    def test_validator_monitor_retires_skipped_epochs(self):
+        """A multi-epoch head jump must count misses for EVERY retired
+        epoch in the gap, not only the watermark (ADVICE r5)."""
+        from lighthouse_tpu.chain.validator_monitor import ValidatorMonitor
+        from lighthouse_tpu.types import MINIMAL
+
+        spe = MINIMAL.slots_per_epoch
+        mon = ValidatorMonitor()
+        mon.register_validator(0)
+
+        def state_at_epoch(epoch, flags=0):
+            return SimpleNamespace(
+                slot=epoch * spe,
+                validators=[
+                    SimpleNamespace(
+                        activation_epoch=0,
+                        exit_epoch=2**64 - 1,
+                        slashed=False,
+                        effective_balance=32 * 10**9,
+                        activation_eligibility_epoch=0,
+                        withdrawable_epoch=2**64 - 1,
+                    )
+                ],
+                previous_epoch_participation=[flags],
+            )
+
+        mon.evaluate_epoch(state_at_epoch(2), MINIMAL)  # grades e1: miss
+        # simulate an earlier head change having graded epoch 2 as a miss
+        s2 = mon.validators[0].summary(2)
+        s2.target_hit = s2.head_hit = False
+        before = mon._target_misses.value
+        # head JUMPS to epoch 6: epochs 1..4 retire; 1 and 2 hold misses
+        mon.evaluate_epoch(state_at_epoch(6, flags=0b111), MINIMAL)
+        assert mon._target_misses.value - before == 2
+        assert mon._retired_through == 4
+
+    def test_wire_score_cache_ttl(self):
+        """Relay scores come from the TTL snapshot: at most one scorer
+        computation per peer per TTL."""
+        from lighthouse_tpu.network.wire import WireBus
+
+        node = WireBus.__new__(WireBus)
+        calls = []
+        node.scorer = SimpleNamespace(
+            score=lambda pid: calls.append(pid) or -1.0
+        )
+        node.score_ttl_s = 1000.0  # never expires within this test
+        node._score_cache = {}
+        first = node._cached_scores(["a", "b"])
+        again = node._cached_scores(["a", "b"])
+        assert first == again == {"a": -1.0, "b": -1.0}
+        assert calls == ["a", "b"]  # second pass fully cache-served

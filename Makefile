@@ -2,7 +2,7 @@
 
 PY ?= python
 
-.PHONY: lint lint-baseline test test-lint test-chaos
+.PHONY: lint lint-baseline test test-lint test-chaos test-crash
 
 ## lint: AST consensus-safety & TPU-hazard pass (tools/lint, stdlib-only)
 lint:
@@ -27,3 +27,8 @@ test-lint:
 test-chaos:
 	JAX_PLATFORMS=cpu $(PY) -m pytest tests/test_resilience.py -q \
 		-m chaos -p no:cacheprovider
+
+## test-crash: crash-injection matrix + WAL recovery + fsck (the CI crash job)
+test-crash:
+	JAX_PLATFORMS=cpu $(PY) -m pytest tests/test_crash_safety.py -q \
+		-p no:cacheprovider

@@ -24,7 +24,7 @@ from ..state_transition import (
 )
 from ..types import compute_epoch_at_slot, compute_start_slot_at_epoch
 from ..types.presets import Preset
-from ..store.hot_cold import HotColdDB
+from ..store.hot_cold import HotColdDB, StoreError
 from ..utils.slot_clock import ManualSlotClock
 from ..utils.timeout_lock import TimeoutRLock
 
@@ -91,15 +91,24 @@ class BeaconChain:
             genesis_state, preset
         )
 
-        store.put_state(genesis_state_root, genesis_state)
-        store.put_chain_item(
+        # genesis/anchor init is ONE atomic batch: the state row, its
+        # post-state mapping, the head pointer pair, and the anchors
+        # commit together, so a crash mid-init leaves either a fresh
+        # empty store or a complete chain — never a head pointing at a
+        # state that was not written (write-ahead journal, store/kv.py)
+        init_batch = store.batch()
+        store.put_state(genesis_state_root, genesis_state, batch=init_batch)
+        init_batch.stage_chain_item(
             b"block_post_state:" + genesis_root, genesis_state_root
         )
-        store.put_chain_item(b"head_block_root", genesis_root)
-        store.put_chain_item(b"head_state_root", genesis_state_root)
+        init_batch.stage_chain_item(b"head_block_root", genesis_root)
+        init_batch.stage_chain_item(b"head_state_root", genesis_state_root)
         # stable anchor for the freezer's chunked block-root fill (slot 0's
-        # "block" is the genesis header, never a stored block)
-        store.put_chain_item(b"genesis_block_root", genesis_root)
+        # "block" is the genesis header, never a stored block). Keep an
+        # existing anchor: a FromStore re-init passes the RESUMED head
+        # state through here, which must not clobber the true genesis root.
+        if store.get_chain_item(b"genesis_block_root") is None:
+            init_batch.stage_chain_item(b"genesis_block_root", genesis_root)
         self.head_root = genesis_root
         self.head_state = clone_state(genesis_state)
         # bounded snapshot cache over the store (snapshot_cache.rs seat):
@@ -112,16 +121,25 @@ class BeaconChain:
         # backfill anchor (historical_blocks.rs oldest_block_slot): the
         # earliest block this node holds; genesis start = nothing to fill.
         # Persisted so from_store restarts don't re-backfill known history.
+        # Keep an existing anchor, like genesis_block_root above: a
+        # FromStore re-init runs this with the RESUMED head state, and
+        # clobbering the persisted anchor with the head (even transiently,
+        # for from_store to restore in a later batch) opens a crash window
+        # that durably re-anchors backfill at the head. from_anchor and
+        # sync backfill advance the anchor through their own batches.
         self.oldest_block_root = genesis_root
         self.oldest_block_slot = genesis_state.slot
         self.oldest_block_parent = bytes(
             genesis_state.latest_block_header.parent_root
         )
-        store.put_chain_item(b"oldest_block_root", genesis_root)
-        store.put_chain_item(
-            b"oldest_block_meta",
-            genesis_state.slot.to_bytes(8, "little") + self.oldest_block_parent,
-        )
+        if store.get_chain_item(b"oldest_block_root") is None:
+            init_batch.stage_chain_item(b"oldest_block_root", genesis_root)
+            init_batch.stage_chain_item(
+                b"oldest_block_meta",
+                genesis_state.slot.to_bytes(8, "little")
+                + self.oldest_block_parent,
+            )
+        init_batch.commit()
         # decompressed-pubkey cache + device-resident limb table
         # (validator_pubkey_cache.rs): decompress once at startup, append on
         # deposit processing; verification paths resolve keys through it
@@ -187,15 +205,17 @@ class BeaconChain:
         chain = cls(store, anchor_state, preset, spec, slot_clock=slot_clock)
         if chain.genesis_block_root != block_root:
             raise BlockError("anchor state header does not match anchor block")
-        store.put_block(block_root, anchor_block)
         chain.oldest_block_root = block_root
         chain.oldest_block_slot = block.slot
         chain.oldest_block_parent = bytes(block.parent_root)
-        store.put_chain_item(b"oldest_block_root", block_root)
-        store.put_chain_item(
+        batch = store.batch()
+        store.put_block(block_root, anchor_block, batch=batch)
+        batch.stage_chain_item(b"oldest_block_root", block_root)
+        batch.stage_chain_item(
             b"oldest_block_meta",
             block.slot.to_bytes(8, "little") + chain.oldest_block_parent,
         )
+        batch.commit()
         return chain
 
     @classmethod
@@ -203,18 +223,50 @@ class BeaconChain:
         cls, store: HotColdDB, preset: Preset, spec, slot_clock=None
     ) -> "BeaconChain":
         """Node-restart resume (ClientGenesis::FromStore): reload the
-        persisted head and continue."""
+        persisted head and continue.
+
+        A corrupt head pointer (head_block_root that resolves to no
+        stored block/state) is survivable: the node logs loudly and
+        falls back to the persisted finalized checkpoint — losing the
+        unfinalized tip beats refusing to start (the reference recovers
+        the same way via fork_revert / the anchor on disk)."""
         head_root = store.get_chain_item(b"head_block_root")
         state_root = store.get_chain_item(b"head_state_root")
         if head_root is None or state_root is None:
             raise BlockError("store holds no persisted chain")
-        # get_state replays from the nearest stored snapshot when the head
-        # landed between snapshot slots (summary-only entry)
-        state = store.get_state(state_root)
+        state = None
+        if store.get_chain_item(b"block_post_state:" + head_root) is not None:
+            try:
+                # get_state replays from the nearest stored snapshot when
+                # the head landed between snapshot slots (summary entry)
+                state = store.get_state(state_root)
+            except StoreError:
+                state = None
         if state is None:
-            raise BlockError("persisted head state missing")
-        # snapshot the persisted anchor BEFORE __init__ overwrites it with
-        # the resumed head's (head != true anchor after any sync progress)
+            fallback = store.get_chain_item(
+                b"finalized_block_root"
+            ) or store.get_chain_item(b"genesis_block_root")
+            fb_state_root = fallback and store.get_chain_item(
+                b"block_post_state:" + fallback
+            )
+            if fb_state_root is None:
+                raise BlockError("persisted head state missing")
+            from ..utils.logging import Logger
+
+            Logger(level="error").child(service="chain").crit(
+                "head pointer corrupt; falling back to finalized checkpoint",
+                head=head_root.hex(),
+                fallback=fallback.hex(),
+            )
+            try:
+                state = store.get_state(fb_state_root)
+            except StoreError as e:
+                raise BlockError(
+                    f"persisted head AND finalized states missing: {e}"
+                ) from None
+        # the persisted anchor survives __init__ untouched (its keep-existing
+        # guard); only the in-memory mirror needs restoring — no store write,
+        # so there is no crash window that could tear the anchor
         oldest = store.get_chain_item(b"oldest_block_root")
         meta = store.get_chain_item(b"oldest_block_meta")
         chain = cls(store, state, preset, spec, slot_clock=slot_clock)
@@ -222,8 +274,6 @@ class BeaconChain:
             chain.oldest_block_root = oldest
             chain.oldest_block_slot = int.from_bytes(meta[:8], "little")
             chain.oldest_block_parent = meta[8:]
-            store.put_chain_item(b"oldest_block_root", oldest)
-            store.put_chain_item(b"oldest_block_meta", meta)
         return chain
 
     # -- time ----------------------------------------------------------------
@@ -254,8 +304,6 @@ class BeaconChain:
         unlocked; only the fork-choice mutation takes the chain lock."""
         if self.execution_layer is None:
             return
-        from ..store.kv import Column as _Col
-
         for root, parent_hash in list(
             self.optimistic_transition_blocks.items()
         ):
@@ -264,7 +312,7 @@ class BeaconChain:
                 # discarded): nothing left to re-verify -- without this,
                 # an engine with no pow surface re-polls forever
                 self.optimistic_transition_blocks.pop(root, None)
-                self.store.kv.delete(_Col.CHAIN, b"otb:" + root)
+                self.store.delete_chain_item(b"otb:" + root)
                 continue
             verdict = self.execution_layer.validate_merge_block(
                 parent_hash, self.spec
@@ -272,7 +320,7 @@ class BeaconChain:
             if verdict is None:
                 continue  # still no pow data; keep waiting
             self.optimistic_transition_blocks.pop(root, None)
-            self.store.kv.delete(_Col.CHAIN, b"otb:" + root)
+            self.store.delete_chain_item(b"otb:" + root)
             if verdict is False:
                 with self.lock:
                     self.fork_choice.on_invalid_execution_payload(root)
@@ -419,20 +467,28 @@ class BeaconChain:
         # new keys now (import_new_pubkeys, validator_pubkey_cache.rs:79)
         self.pubkey_cache.import_new_pubkeys(state)
 
-        self.store.put_block(block_root, signed_block)
+        # the block row, its post-state, the post-state mapping, and any
+        # OTB marker commit as ONE atomic batch: a crash mid-import can
+        # never store a block whose state (or mapping) is missing
+        import_batch = self.store.batch()
+        self.store.put_block(block_root, signed_block, batch=import_batch)
         # drop the incremental-hash cache before retaining: stored states
         # are never re-rooted in place (later work clones them), so keeping
         # the merkle layers would ~double per-state memory for nothing
         state.__dict__.pop("_lh_tree_cache", None)
-        self.store.put_state(state_root, state)
-        self.store.put_chain_item(
+        self.store.put_state(state_root, state, batch=import_batch)
+        import_batch.stage_chain_item(
             b"block_post_state:" + block_root, state_root
         )
+        if otb_parent_hash is not None:
+            import_batch.stage_chain_item(
+                b"otb:" + block_root, otb_parent_hash
+            )
+        import_batch.commit()
         self._states[block_root] = state
         self.early_attester_cache.add(self.preset, block_root, block, state)
         if otb_parent_hash is not None:
             self.optimistic_transition_blocks[block_root] = otb_parent_hash
-            self.store.put_chain_item(b"otb:" + block_root, otb_parent_hash)
 
         with M.BLOCK_FORK_CHOICE_TIMES.time():
             self._fork_choice_import(
@@ -572,13 +628,17 @@ class BeaconChain:
             # duty lookahead); aliasing the cached post-state would corrupt
             # the canonical chain (reference snapshots in canonical_head.rs).
             self.head_state = clone_state(self._states[head])
-            # persist the head pointer for FromStore restart resume
-            self.store.put_chain_item(b"head_block_root", head)
+            # persist the head pointer PAIR atomically for FromStore
+            # restart resume: a crash between the two writes would leave
+            # a head block pointing at the previous head's state
+            batch = self.store.batch()
+            batch.stage_chain_item(b"head_block_root", head)
             state_root = self.store.get_chain_item(
                 b"block_post_state:" + head
             )
             if state_root is not None:
-                self.store.put_chain_item(b"head_state_root", state_root)
+                batch.stage_chain_item(b"head_state_root", state_root)
+            batch.commit()
             if self.validator_monitor is not None:
                 # per-epoch grading from the head state's participation
                 # flags (validator_monitor.rs process_valid_state); the
@@ -650,6 +710,9 @@ class BeaconChain:
             if blk.message.slot < fin_slot and root != fin_root:
                 del self._states[root]
         self.store.migrate_to_freezer(
-            fin_slot, canonical, finalized_state=self._states.get(fin_root)
+            fin_slot,
+            canonical,
+            finalized_state=self._states.get(fin_root),
+            finalized_block_root=fin_root,
         )
         self.fork_choice.proto.proto_array.maybe_prune(fin_root)

@@ -34,6 +34,31 @@ def _spec_preset(args):
     return preset, spec
 
 
+def _lock_datadir(datadir: str) -> int:
+    """Exclusive advisory lock on <datadir>/LOCK (the seat of LevelDB's
+    LOCK file): one process per datadir. Without it, `db fsck`'s
+    open-time journal recovery racing a live node's in-flight batch
+    could replay the intent record and delete the journal row out from
+    under the node — whose crash then reopens "clean" with a torn batch,
+    exactly the state the WAL exists to rule out. Returns the held fd;
+    the caller keeps it referenced so the lock lives as long as the
+    process."""
+    import fcntl
+    import os
+
+    os.makedirs(datadir, exist_ok=True)
+    fd = os.open(os.path.join(datadir, "LOCK"), os.O_CREAT | os.O_RDWR)
+    try:
+        fcntl.flock(fd, fcntl.LOCK_EX | fcntl.LOCK_NB)
+    except OSError:
+        os.close(fd)
+        raise SystemExit(
+            f"datadir {datadir!r} is locked by another process (a running "
+            "node?); stop it before running this command"
+        )
+    return fd
+
+
 def _add_network_args(p):
     p.add_argument("--network", default="interop",
                    choices=["interop", "minimal", "mainnet", "sepolia",
@@ -188,6 +213,8 @@ def build_beacon_node(args):
     if args.datadir:
         import os
 
+        # held (via the args reference) for the life of the node
+        args._datadir_lock = _lock_datadir(args.datadir)
         native_path = os.path.join(args.datadir, "chain.db")
         if os.path.isdir(args.datadir) and not os.path.exists(
             native_path
@@ -443,6 +470,10 @@ def cmd_db(args):
 
     from .store.kv import Column, FileStore
 
+    if args.db_cmd in ("fsck", "prune-payloads", "compact"):
+        # these WRITE (fsck included: opening runs journal recovery) —
+        # refuse to race a live node on the same datadir
+        args._datadir_lock = _lock_datadir(args.datadir)
     native_path = os.path.join(args.datadir, "chain.db")
     if os.path.isfile(native_path):
         from .store.native_kv import NativeStore
@@ -451,9 +482,47 @@ def cmd_db(args):
     else:
         kv = FileStore(args.datadir)
     if args.db_cmd == "inspect":
-        for name in ("BLOCK", "STATE", "STATE_SUMMARY", "FREEZER_BLOCK"):
-            col = getattr(Column, name)
-            print(f"{name.lower()}: {len(kv.keys(col))} entries")
+        import struct
+
+        from .store.kv import JOURNAL_KEY
+        from .store.metadata import get_schema_version
+
+        counts = {}
+        for name in (
+            "BLOCK", "STATE", "STATE_SUMMARY", "CHAIN", "FREEZER_BLOCK",
+            "FREEZER_STATE", "FREEZER_BLOCK_ROOTS", "FREEZER_STATE_ROOTS",
+        ):
+            counts[name.lower()] = len(kv.keys(getattr(Column, name)))
+        split = kv.get(Column.CHAIN, b"split_slot")
+        print(json.dumps({
+            "columns": counts,
+            "schema_version": get_schema_version(kv),
+            "split_slot": struct.unpack(">Q", split)[0] if split else 0,
+            "journal_pending": kv.get(Column.JOURNAL, JOURNAL_KEY)
+            is not None,
+        }, indent=1))
+    elif args.db_cmd == "fsck":
+        from .store.fsck import run_fsck
+        from .store.hot_cold import HotColdDB
+
+        preset, spec = _spec_preset(args)
+        # opening IS the recovery path: an interrupted batch replays or
+        # rolls back here, then the invariant walk checks what is left.
+        # --slots-per-restore-point matters for databases written before
+        # the stride was persisted (fsck prefers the stored value when
+        # present): checking a custom-stride datadir at the default
+        # stride would report spurious missing restore points.
+        db = HotColdDB(
+            kv, preset, spec,
+            slots_per_restore_point=args.slots_per_restore_point,
+        )
+        issues = run_fsck(db)
+        print(json.dumps({
+            "journal_recovery": db.journal_recovery,
+            "clean": not issues,
+            "issues": [str(i) for i in issues],
+        }, indent=1))
+        return 0 if not issues else 1
     elif args.db_cmd == "compact":
         if not hasattr(kv, "compact"):
             print("compact: not supported for this datadir format")
@@ -687,9 +756,14 @@ def main(argv=None) -> int:
     _add_network_args(db)
     db.add_argument(
         "db_cmd",
-        choices=["inspect", "compact", "version", "prune-payloads"],
+        choices=["inspect", "fsck", "compact", "version", "prune-payloads"],
     )
     db.add_argument("--datadir", required=True)
+    db.add_argument(
+        "--slots-per-restore-point", type=int, default=None,
+        help="stride the node ran with (fsck fallback for databases "
+        "written before the stride was persisted in the chain column)",
+    )
     db.set_defaults(fn=cmd_db)
 
     tools = sub.add_parser("tools", help="dev tools (lcli)")

@@ -1117,17 +1117,12 @@ class BeaconApi:
     def lighthouse_database_reconstruct(self) -> dict:
         """POST /lighthouse/database/reconstruct (lib.rs:3155): fill any
         missing restore-point states below the split from the chunked
-        columns (the reference's historic state reconstruction trigger)."""
-        from ..store.kv import Column
-
-        store = self.chain.store
-        before = len(store.kv.keys(Column.FREEZER_STATE))
-        store._store_restore_points(0, store.split_slot)
-        after = len(store.kv.keys(Column.FREEZER_STATE))
+        columns (the reference's historic state reconstruction trigger).
+        The store owns the bounded per-stride batch sweep and its marker
+        semantics (HotColdDB.reconstruct_historic_states)."""
+        added = self.chain.store.reconstruct_historic_states()
         return {
-            "data": (
-                f"reconstruction complete: +{after - before} restore points"
-            )
+            "data": f"reconstruction complete: +{added} restore points"
         }
 
     def lighthouse_liveness(self, indices: list, epoch: int) -> dict:

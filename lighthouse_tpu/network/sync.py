@@ -210,20 +210,30 @@ class SyncManager:
             if not ok:
                 self.node.penalize(peer)
                 continue
+            # blocks + the advanced anchor commit atomically: a crash
+            # between them would otherwise leave an anchor claiming
+            # history the store does not hold (or vice versa)
+            batch = chain.store.batch()
             for blk in blocks:
-                chain.store.put_block(blk.message.tree_hash_root(), blk)
+                chain.store.put_block(
+                    blk.message.tree_hash_root(), blk, batch=batch
+                )
                 stored += 1
             first = blocks[0].message
-            chain.oldest_block_root = first.tree_hash_root()
-            chain.oldest_block_slot = first.slot
-            chain.oldest_block_parent = bytes(first.parent_root)
-            chain.store.put_chain_item(
-                b"oldest_block_root", chain.oldest_block_root
-            )
-            chain.store.put_chain_item(
+            anchor_root = first.tree_hash_root()
+            anchor_parent = bytes(first.parent_root)
+            batch.stage_chain_item(b"oldest_block_root", anchor_root)
+            batch.stage_chain_item(
                 b"oldest_block_meta",
-                first.slot.to_bytes(8, "little") + chain.oldest_block_parent,
+                first.slot.to_bytes(8, "little") + anchor_parent,
             )
+            batch.commit()
+            # in-memory mirrors advance only AFTER the batch is durable
+            # (migrate_to_freezer's idiom): a failed commit must not leave
+            # the running node claiming history the store does not hold
+            chain.oldest_block_root = anchor_root
+            chain.oldest_block_slot = first.slot
+            chain.oldest_block_parent = anchor_parent
         return stored
 
     # -- unknown-block lookups (block_lookups/mod.rs) -----------------------

@@ -11,6 +11,12 @@ contract asserted by tests/test_resilience.py).
 Fault injection (`faults.py`) wraps any provider/backend/engine duck
 type in a seeded `FaultPlan` that injects errors, delays, and hangs on
 a deterministic schedule, usable from tests and network/simulator.py.
+
+Crash injection (`crash.py`) is the process-death counterpart for the
+store layer: a seeded `CrashPlan`/`CrashingStore` kills the
+process-under-test at the Nth kv op — including torn writes — so the
+crash-safety suite can crash at EVERY op index of an atomic batch and
+assert reopen-time journal recovery.
 """
 
 from .primitives import (  # noqa: F401
@@ -30,4 +36,9 @@ from .faults import (  # noqa: F401
     FaultPlan,
     FaultyProxy,
     InjectedHang,
+)
+from .crash import (  # noqa: F401
+    CrashPlan,
+    CrashingStore,
+    InjectedCrash,
 )

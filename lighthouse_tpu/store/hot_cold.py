@@ -8,12 +8,13 @@ between snapshots/restore points are rebuilt by block replay
 from __future__ import annotations
 
 import struct
+import threading
 
 from ..ssz import cached_root as cached_root_of
 from ..state_transition import BlockReplayer, clone_state, process_slots
 from ..types import compute_epoch_at_slot, state_class_for, types_for
 from ..types.presets import Preset
-from .kv import Column, KeyValueStore, slot_key
+from .kv import AtomicBatch, Column, KeyValueStore, recover_journal, slot_key
 
 
 class StoreError(KeyError):
@@ -44,9 +45,26 @@ def latest_block_header_root(state, state_root: bytes) -> bytes:
 CHUNK_SIZE = 128  # roots per freezer chunk row (chunked_vector.rs: 4K pages)
 
 
+def chunk_root_in_row(row: bytes | None, slot: int) -> bytes | None:
+    """Decode `slot`'s 32-byte root from its chunk row. None means absent:
+    no row, a row too short to cover the slot, or the all-zero unwritten
+    sentinel. The ONE place chunk framing is interpreted — _chunk_get,
+    _ChunkWriter.root_at, and fsck's contiguity walk all read through it."""
+    if row is None:
+        return None
+    offset = (slot % CHUNK_SIZE) * 32
+    if len(row) < offset + 32:
+        return None
+    root = bytes(row[offset : offset + 32])
+    return root if any(root) else None
+
+
 class _ChunkWriter:
     """Buffers chunked-column writes so a migration touches each 4K chunk
-    row once instead of read-modify-writing it per slot."""
+    row once instead of read-modify-writing it per slot. Doubles as the
+    read-through overlay for an atomic migration batch: `root_at` sees
+    staged rows before they commit, so later migration phases (restore
+    points) can read the root vectors the same batch is about to write."""
 
     def __init__(self, kv: KeyValueStore):
         self.kv = kv
@@ -66,9 +84,25 @@ class _ChunkWriter:
             row.extend(bytes(offset + 32 - len(row)))
         row[offset : offset + 32] = root
 
+    def root_at(self, column: bytes, slot: int) -> bytes | None:
+        """Staged-or-stored read of one root (the overlay view)."""
+        cindex = slot // CHUNK_SIZE
+        row = self.rows.get((column, cindex))
+        if row is None:
+            row = self.kv.get(column, struct.pack(">Q", cindex))
+        elif not isinstance(row, bytes):
+            row = bytes(row)
+        return chunk_root_in_row(row, slot)
+
     def flush(self) -> None:
         for (column, cindex), row in self.rows.items():
             self.kv.put(column, struct.pack(">Q", cindex), bytes(row))
+        self.rows.clear()
+
+    def flush_into(self, batch: AtomicBatch) -> None:
+        """Stage the buffered rows on `batch` instead of writing them."""
+        for (column, cindex), row in self.rows.items():
+            batch.stage(column, struct.pack(">Q", cindex), bytes(row))
         self.rows.clear()
 
 
@@ -93,8 +127,18 @@ class HotColdDB:
         self.slots_per_restore_point = (
             slots_per_restore_point or 4 * preset.slots_per_epoch
         )
-        # schema stamp + open-time migrations (metadata.rs,
-        # schema_change.rs); refuses newer-schema databases
+        # serializes multi-batch freezer mutations (migrate_to_freezer,
+        # reconstruct_historic_states, prune_payloads) across threads:
+        # kv.do_atomically makes each BATCH atomic, but the
+        # restore_points_to marker is read-modify-written across a long
+        # scan, and an HTTP-thread reconstruct racing a chain-thread
+        # migration could commit a stale smaller marker over a fresh one
+        self._mutation_lock = threading.Lock()
+        # write-ahead journal recovery FIRST (an interrupted batch from
+        # the previous process must replay or roll back before anything
+        # reads the store), then the schema stamp + open-time migrations
+        # (metadata.rs, schema_change.rs); refuses newer-schema databases
+        self.journal_recovery = recover_journal(kv)
         from .metadata import ensure_schema
 
         self.schema_migrations_applied = ensure_schema(kv, preset)
@@ -109,12 +153,22 @@ class HotColdDB:
             struct.unpack(">Q", stored_fill)[0] if stored_fill else 0
         )
 
+    # -- atomic batches ------------------------------------------------------
+
+    def batch(self) -> AtomicBatch:
+        """A staged multi-key mutation over this store's kv; commit()
+        applies it all-or-nothing through the write-ahead journal."""
+        return AtomicBatch(self.kv)
+
     # -- blocks --------------------------------------------------------------
 
-    def put_block(self, block_root: bytes, signed_block) -> None:
+    def put_block(self, block_root: bytes, signed_block, batch=None) -> None:
         fork = type(signed_block).fork_name
         payload = fork.encode() + b"\x00" + signed_block.as_ssz_bytes()
-        self.kv.put(Column.BLOCK, block_root, payload)
+        if batch is not None:
+            batch.stage(Column.BLOCK, block_root, payload)
+        else:
+            self.kv.put(Column.BLOCK, block_root, payload)
 
     def _decode_stored_block(self, data: bytes):
         fork, _, body = data.partition(b"\x00")
@@ -136,22 +190,27 @@ class HotColdDB:
 
     # -- states --------------------------------------------------------------
 
-    def put_state(self, state_root: bytes, state) -> None:
+    def put_state(self, state_root: bytes, state, batch=None) -> None:
         """Full state at snapshot cadence; otherwise a summary pointing to
         the previous snapshot (hot_cold_store.rs stores per-slot summaries
-        + periodic full states the same way)."""
+        + periodic full states the same way). The state row and its
+        slot-index row commit together: without a `batch` a private one
+        is committed here, so a crash can never index an absent state."""
+        sink = batch if batch is not None else self.batch()
         if state.slot % self.slots_per_snapshot == 0:
             payload = (
                 b"F" + state.fork_name.encode() + b"\x00" + state.as_ssz_bytes()
             )
-            self.kv.put(Column.STATE, state_root, payload)
+            sink.stage(Column.STATE, state_root, payload)
         else:
             block_root = latest_block_header_root(state, state_root)
             summary = struct.pack(">Q", state.slot) + block_root
-            self.kv.put(Column.STATE_SUMMARY, state_root, summary)
-        self.kv.put(
-            Column.CHAIN, b"state_at_slot:" + slot_key(state.slot), state_root
+            sink.stage(Column.STATE_SUMMARY, state_root, summary)
+        sink.stage_chain_item(
+            b"state_at_slot:" + slot_key(state.slot), state_root
         )
+        if batch is None:
+            sink.commit()
 
     def get_full_state(self, state_root: bytes):
         data = self.kv.get(Column.STATE, state_root)
@@ -211,6 +270,9 @@ class HotColdDB:
     def put_chain_item(self, key: bytes, value: bytes) -> None:
         self.kv.put(Column.CHAIN, key, value)
 
+    def delete_chain_item(self, key: bytes) -> None:
+        self.kv.delete(Column.CHAIN, key)
+
     def get_chain_item(self, key: bytes) -> bytes | None:
         return self.kv.get(Column.CHAIN, key)
 
@@ -227,13 +289,7 @@ class HotColdDB:
 
     def _chunk_get(self, column: bytes, slot: int) -> bytes | None:
         row = self.kv.get(column, struct.pack(">Q", slot // CHUNK_SIZE))
-        if row is None:
-            return None
-        offset = (slot % CHUNK_SIZE) * 32
-        if len(row) < offset + 32:
-            return None
-        root = bytes(row[offset : offset + 32])
-        return root if any(root) else None
+        return chunk_root_in_row(row, slot)
 
     def cold_block_root_at_slot(self, slot: int) -> bytes | None:
         return self._chunk_get(Column.FREEZER_BLOCK_ROOTS, slot)
@@ -244,7 +300,11 @@ class HotColdDB:
     # -- freezer migration (hot_cold_store.rs:48-53 + migrate.rs) -----------
 
     def migrate_to_freezer(
-        self, finalized_slot: int, canonical_roots, finalized_state=None
+        self,
+        finalized_slot: int,
+        canonical_roots,
+        finalized_state=None,
+        finalized_block_root: bytes | None = None,
     ) -> None:
         """Move finalized blocks to the freezer column and advance the
         split point; prune non-canonical hot entries older than the split.
@@ -255,37 +315,76 @@ class HotColdDB:
         roots into the chunked columns and stores restore-point states at
         slots_per_restore_point cadence — historical loads then cost at
         most one restore-point read + a bounded block replay
-        (hot_cold_store.rs store_cold_state/load_cold_state)."""
-        old_split = self.split_slot
-        migrated = []  # canonical (slot, root) for per-slot root derivation
-        for root in list(self.kv.keys(Column.BLOCK)):
-            data = self.kv.get(Column.BLOCK, root)
-            if data is None:
-                continue
-            block = self.get_block(root)
-            if block.message.slot < finalized_slot:
-                if root in canonical_roots:
-                    self.kv.put(Column.FREEZER_BLOCK, root, data)
-                    migrated.append((int(block.message.slot), bytes(root)))
-                self.kv.delete(Column.BLOCK, root)
-        self._freeze_block_roots(old_split, finalized_slot, migrated)
-        if finalized_state is not None:
-            self._freeze_state_roots(finalized_slot, finalized_state)
-        self._store_restore_points(old_split, finalized_slot)
-        self.split_slot = finalized_slot
-        self.put_chain_item(b"split_slot", struct.pack(">Q", finalized_slot))
+        (hot_cold_store.rs store_cold_state/load_cold_state).
+
+        The whole migration — freezer copies, hot prunes, chunked root
+        rows, restore points, the finalized-checkpoint pointer, and the
+        split-slot advance — commits as ONE atomic batch through the
+        write-ahead journal: a crash at any store op replays or rolls
+        back on reopen, so `split_slot` can never name freezer contents
+        that are not there (the torn state the reference's leveldb
+        write-batches rule out)."""
+        with self._mutation_lock:
+            old_split = self.split_slot
+            batch = self.batch()
+            chunks = _ChunkWriter(self.kv)
+            migrated = []  # canonical (slot, root) for root derivation
+            for root in list(self.kv.keys(Column.BLOCK)):
+                data = self.kv.get(Column.BLOCK, root)
+                if data is None:
+                    continue
+                block = self.get_block(root)
+                if block.message.slot < finalized_slot:
+                    if root in canonical_roots:
+                        batch.stage(Column.FREEZER_BLOCK, root, data)
+                        migrated.append(
+                            (int(block.message.slot), bytes(root))
+                        )
+                    batch.stage_delete(Column.BLOCK, root)
+            self._freeze_block_roots(
+                old_split, finalized_slot, migrated, chunks
+            )
+            filled_to = self._state_roots_filled_to
+            if finalized_state is not None:
+                filled_to = self._freeze_state_roots(
+                    finalized_slot, finalized_state, chunks, batch
+                )
+            self._store_restore_points(finalized_slot, chunks, batch)
+            chunks.flush_into(batch)
+            batch.stage_chain_item(
+                b"split_slot", struct.pack(">Q", finalized_slot)
+            )
+            batch.stage_chain_item(
+                b"slots_per_restore_point",
+                struct.pack(">Q", self.slots_per_restore_point),
+            )
+            if finalized_block_root is not None:
+                batch.stage_chain_item(
+                    b"finalized_block_root", bytes(finalized_block_root)
+                )
+            batch.commit()
+            # in-memory mirrors advance only AFTER the batch is durable,
+            # so a commit-time crash leaves this object consistent with
+            # the disk
+            self.split_slot = finalized_slot
+            self._state_roots_filled_to = filled_to
 
     def _freeze_block_roots(
-        self, old_split: int, finalized_slot: int, migrated
+        self, old_split: int, finalized_slot: int, migrated, chunks
     ) -> None:
         """Per-slot block roots for [old_split, finalized_slot) from the
         migrated canonical blocks themselves (ring semantics: an empty slot
         repeats the previous block's root) — coverage never depends on any
-        state's ring buffer, so long non-finality cannot punch holes."""
-        writer = _ChunkWriter(self.kv)
+        state's ring buffer, so long non-finality cannot punch holes.
+        Rows are staged on the shared `chunks` overlay; the migration
+        batch flushes them."""
         migrated.sort()
         cursor = 0
-        prev = self.cold_block_root_at_slot(old_split - 1) if old_split else None
+        prev = (
+            chunks.root_at(Column.FREEZER_BLOCK_ROOTS, old_split - 1)
+            if old_split
+            else None
+        )
         for slot in range(old_split, finalized_slot):
             while cursor < len(migrated) and migrated[cursor][0] <= slot:
                 prev = migrated[cursor][1]
@@ -301,10 +400,11 @@ class HotColdDB:
                 ) or self.get_chain_item(b"oldest_block_root")
                 if prev is None:
                     continue
-            writer.put(Column.FREEZER_BLOCK_ROOTS, slot, prev)
-        writer.flush()
+            chunks.put(Column.FREEZER_BLOCK_ROOTS, slot, prev)
 
-    def _freeze_state_roots(self, finalized_slot: int, finalized_state) -> None:
+    def _freeze_state_roots(
+        self, finalized_slot: int, finalized_state, chunks, batch
+    ) -> int:
         """State roots from the finalized state's ring, tracked by a
         persisted low-water mark: a finalized epoch that starts with empty
         slots leaves the tail unmaterialized this round, and the NEXT
@@ -316,57 +416,71 @@ class HotColdDB:
         is patched from the canonical frozen blocks themselves: a block's
         state_root IS the state root at its slot. Only empty slots inside
         such a stretch stay unrecorded (their states were never part of
-        any block), and the state-roots iterator raises for them."""
-        writer = _ChunkWriter(self.kv)
+        any block), and the state-roots iterator raises for them.
+
+        Stages rows on `chunks` / items on `batch`; returns the new
+        low-water mark for the caller to adopt after commit."""
         ring = self.preset.slots_per_historical_root
         covered = min(finalized_slot, int(finalized_state.slot))
         lo = max(self._state_roots_filled_to, covered - ring)
         for slot in range(self._state_roots_filled_to, lo):
-            root = self.cold_block_root_at_slot(slot)
+            root = chunks.root_at(Column.FREEZER_BLOCK_ROOTS, slot)
             if root is None:
                 continue
-            if slot and root == self.cold_block_root_at_slot(slot - 1):
+            if slot and root == chunks.root_at(
+                Column.FREEZER_BLOCK_ROOTS, slot - 1
+            ):
                 continue  # empty slot: no block-anchored state root
             block = self.get_block_any_temperature(root)
             if block is not None and int(block.message.slot) == slot:
-                writer.put(
+                chunks.put(
                     Column.FREEZER_STATE_ROOTS,
                     slot,
                     bytes(block.message.state_root),
                 )
         for slot in range(lo, covered):
-            writer.put(
+            chunks.put(
                 Column.FREEZER_STATE_ROOTS,
                 slot,
                 bytes(finalized_state.state_roots[slot % ring]),
             )
-        writer.flush()
         if covered > self._state_roots_filled_to:
-            self._state_roots_filled_to = covered
-            self.put_chain_item(
+            batch.stage_chain_item(
                 b"state_roots_filled_to", struct.pack(">Q", covered)
             )
+            return covered
+        return self._state_roots_filled_to
 
-    def _store_restore_points(self, old_split: int, finalized_slot: int) -> None:
+    def _store_restore_points(
+        self, finalized_slot: int, chunks, batch, scan_from: int | None = None
+    ) -> None:
         """Full states at restore-point cadence, loaded strictly by the
         AUTHORITATIVE root from the chunked column — never by the
         last-writer-wins state_at_slot index, which can name a
-        non-canonical fork's state.
+        non-canonical fork's state. Roots come through the `chunks`
+        overlay (the same batch may have just staged them); the state
+        payloads and the high-water marker are staged on `batch`.
 
         The scan starts at the earliest restore-point slot that is still
-        missing (not at old_split): a slot skipped last round because its
+        missing (the restore_points_to marker, not the split): a slot
+        skipped last round because its
         state root was in an empty-slot gap is retried once the next
-        migration's ring backfill records the root."""
+        migration's ring backfill records the root. `scan_from` lets a
+        caller sweeping bounded sub-ranges (http reconstruct) set the
+        scan floor itself instead of rescanning from the marker every
+        call — which goes quadratic when a permanently-missing state
+        root pins the marker."""
         spr = self.slots_per_restore_point
-        start = 0
+        marker = 0
         stored = self.get_chain_item(b"restore_points_to")
         if stored is not None:
-            start = struct.unpack(">Q", stored)[0]
+            marker = struct.unpack(">Q", stored)[0]
+        start = marker if scan_from is None else scan_from
         all_present = True
         for slot in range(start + (-start % spr), finalized_slot, spr):
             if self.kv.get(Column.FREEZER_STATE, slot_key(slot)) is not None:
                 continue
-            state_root = self.cold_state_root_at_slot(slot)
+            state_root = chunks.root_at(Column.FREEZER_STATE_ROOTS, slot)
             if state_root is None:
                 all_present = False
                 continue
@@ -378,11 +492,44 @@ class HotColdDB:
             payload = (
                 b"F" + state.fork_name.encode() + b"\x00" + state.as_ssz_bytes()
             )
-            self.kv.put(Column.FREEZER_STATE, slot_key(slot), payload)
-        if all_present:
-            self.put_chain_item(
+            batch.stage(Column.FREEZER_STATE, slot_key(slot), payload)
+        # the high-water mark means "every restore point below me exists":
+        # it only advances (a bounded sweep below it must not regress it),
+        # and only when this scan actually covered the ground from the
+        # marker up — a sweep that began ABOVE the marker cannot vouch for
+        # the gap below its floor
+        if all_present and finalized_slot > marker and start <= marker:
+            batch.stage_chain_item(
                 b"restore_points_to", struct.pack(">Q", finalized_slot)
             )
+
+    def reconstruct_historic_states(self) -> int:
+        """Fill any missing restore-point states below the split from the
+        chunked columns (the reference's historic state reconstruction,
+        reconstruct.rs), in bounded journaled batches: each stride
+        interval commits at most one rebuilt full state plus the
+        restore_points_to marker, so memory and journal size stay bounded
+        however long the chain is. The sweep is idempotent — present
+        points are skipped, and the marker only advances over prefixes
+        verified complete — so a crash between batches resumes exactly
+        where it left off. Returns the number of restore points added."""
+        with self._mutation_lock:
+            before = len(self.kv.keys(Column.FREEZER_STATE))
+            spr = self.slots_per_restore_point
+            cursor = 0
+            boundary = spr
+            while True:
+                upto = min(boundary, self.split_slot)
+                batch = self.batch()
+                self._store_restore_points(
+                    upto, _ChunkWriter(self.kv), batch, scan_from=cursor
+                )
+                batch.commit()
+                cursor = upto
+                if upto == self.split_slot:
+                    break
+                boundary += spr
+            return len(self.kv.keys(Column.FREEZER_STATE)) - before
 
     def load_cold_state(self, slot: int):
         """Historical (pre-split) state at `slot`: nearest restore point at
@@ -479,13 +626,23 @@ class HotColdDB:
         Returns the number of pruned blocks. With no explicit boundary the
         prune stops at the hot/cold split (finalized) slot — the reference
         prunes only finalized payloads, never the head's, so the node can
-        still serve full blocks over req/resp and re-notify the EL."""
+        still serve full blocks over req/resp and re-notify the EL.
+
+        Holds the freezer mutation lock: the prune's op list is built
+        from reads of the block columns, and a concurrent migration
+        committing between those reads and this batch's commit would let
+        the prune resurrect a hot row the migration just deleted."""
+        with self._mutation_lock:
+            return self._prune_payloads_locked(before_slot)
+
+    def _prune_payloads_locked(self, before_slot: int | None) -> int:
         from ..state_transition.per_block import payload_to_header
 
         if before_slot is None:
             before_slot = self.split_slot
         t = types_for(self.preset)
         pruned = 0
+        batch = self.batch()
         for col in (Column.BLOCK, Column.FREEZER_BLOCK):
             for root in list(self.kv.keys(col)):
                 data = self.kv.get(col, root)
@@ -526,10 +683,13 @@ class HotColdDB:
                 signed_blinded = t.SignedBlindedBeaconBlock(
                     message=blinded, signature=bytes(signed.signature)
                 )
-                self.kv.put(
+                batch.stage(
                     col,
                     root,
                     b"bellatrix_blinded\x00" + signed_blinded.as_ssz_bytes(),
                 )
                 pruned += 1
+        # one atomic batch: a crash mid-prune can never leave a block
+        # half-rewritten or strand an unprunable mix on disk
+        batch.commit()
         return pruned

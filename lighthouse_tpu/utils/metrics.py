@@ -279,3 +279,25 @@ BLS_BISECTION_CALLS = REGISTRY.counter(
     "bls_bisection_backend_calls_total",
     "Extra backend calls spent isolating invalid sets by bisection",
 )
+
+# -- the crash-safety metric family (store/kv.py journal, store/fsck.py) ------
+# Write-ahead journal recovery outcomes and consistency-checker results:
+# the observable surface of the crash-safe store (reference: leveldb
+# write-batch semantics + `lighthouse db` tooling).
+
+STORE_JOURNAL_REPLAYS = REGISTRY.counter(
+    "store_journal_replays_total",
+    "Committed write-ahead batches re-applied on store reopen (the crash "
+    "hit mid-apply; redo)",
+)
+STORE_JOURNAL_ROLLBACKS = REGISTRY.counter(
+    "store_journal_rollbacks_total",
+    "Torn/uncommitted write-ahead batches discarded on store reopen (the "
+    "crash hit the intent write; the batch never happened)",
+)
+STORE_FSCK_RUNS = REGISTRY.counter(
+    "store_fsck_runs_total", "db fsck consistency walks"
+)
+STORE_FSCK_FAILURES = REGISTRY.counter(
+    "store_fsck_issues_total", "Consistency violations found by db fsck"
+)

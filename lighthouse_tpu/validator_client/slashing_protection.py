@@ -45,6 +45,15 @@ class SlashingDatabase:
         # rusqlite's pooled connections, slashing_database.rs)
         self.conn = sqlite3.connect(path, check_same_thread=False)
         self._lock = threading.RLock()
+        if path != ":memory:":
+            # durability for file-backed databases (the reference's
+            # open_with_default_pool sets the same pair): WAL keeps
+            # readers unblocked during imports, synchronous=FULL makes
+            # every acknowledged signature record survive a power cut —
+            # slashing protection is the one database where losing an
+            # acknowledged write can later equivocate a validator
+            self.conn.execute("PRAGMA journal_mode=WAL")
+            self.conn.execute("PRAGMA synchronous=FULL")
         self.conn.executescript(_SCHEMA)
         self.conn.commit()
 
@@ -219,24 +228,34 @@ class SlashingDatabase:
         # record through the slashing checks, interchange.rs +
         # slashing_database.rs import_interchange_info).
         # `with self.conn` rolls the whole transaction back on any raise:
-        # a slashable conflict anywhere means NO partial import.
+        # a slashable conflict OR a malformed record anywhere means NO
+        # partial import — the database stays byte-identical to its
+        # pre-import state (asserted by the crash-safety suite).
         with self._lock, self.conn:
-            for record in interchange.get("data", []):
-                pubkey = record["pubkey"].removeprefix("0x")
-                vid = self._register_in_txn(pubkey)
-                for b in record.get("signed_blocks", []):
-                    self._import_block(
-                        vid,
-                        int(b["slot"]),
-                        b.get("signing_root", "0x").removeprefix("0x"),
-                    )
-                for a in record.get("signed_attestations", []):
-                    self._import_attestation(
-                        vid,
-                        int(a["source_epoch"]),
-                        int(a["target_epoch"]),
-                        a.get("signing_root", "0x").removeprefix("0x"),
-                    )
+            try:
+                for record in interchange.get("data", []):
+                    pubkey = record["pubkey"].removeprefix("0x")
+                    vid = self._register_in_txn(pubkey)
+                    for b in record.get("signed_blocks", []):
+                        self._import_block(
+                            vid,
+                            int(b["slot"]),
+                            b.get("signing_root", "0x").removeprefix("0x"),
+                        )
+                    for a in record.get("signed_attestations", []):
+                        self._import_attestation(
+                            vid,
+                            int(a["source_epoch"]),
+                            int(a["target_epoch"]),
+                            a.get("signing_root", "0x").removeprefix("0x"),
+                        )
+            except (KeyError, TypeError, ValueError) as e:
+                # a malformed record mid-payload: surface it as the same
+                # refusal type as a slashable one (the transaction exit
+                # rolls back every prior insert either way)
+                if isinstance(e, NotSafe):
+                    raise
+                raise NotSafe(f"malformed interchange record: {e!r}") from e
 
     def _register_in_txn(self, pubkey_hex: str) -> int:
         self.conn.execute(

@@ -48,6 +48,11 @@ def pytest_configure(config):
         "chaos: deterministic fault-injection tests (resilience layer); "
         "CI also runs these as a dedicated step",
     )
+    config.addinivalue_line(
+        "markers",
+        "crash: deterministic crash-injection matrix (store WAL recovery); "
+        "CI also runs these as a dedicated step",
+    )
 
 
 def pytest_collection_modifyitems(session, config, items):

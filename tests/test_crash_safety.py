@@ -1,0 +1,803 @@
+"""Crash-safe store: WAL atomic batches, deterministic crash injection,
+recovery + fsck.
+
+The matrix tests crash the "process" at EVERY kv op index of the real
+atomic batches the node writes — hot->cold migration, payload pruning,
+schema migration, genesis init — then reopen the store the way a
+restarted node would (HotColdDB runs journal recovery) and assert:
+
+* `db fsck` is clean;
+* the store is byte-identical to either the pre-batch or the post-batch
+  state (never anything in between);
+* a rolled-back batch converges to the post state when re-applied;
+* the chain resumes with bit-identical head/finalized roots.
+
+Expensive compute (building a finalized chain) happens once per module;
+the matrix itself replays captured batch ops over copied stores, so a
+hundred crash points cost byte copies, not state transitions.
+"""
+
+from __future__ import annotations
+
+import os
+
+import pytest
+
+from lighthouse_tpu.chain.beacon_chain import BeaconChain
+from lighthouse_tpu.crypto.bls import set_backend
+from lighthouse_tpu.resilience import CrashingStore, CrashPlan, InjectedCrash
+from lighthouse_tpu.resilience.crash import AFTER, CRASH, TORN
+from lighthouse_tpu.store.fsck import run_fsck
+from lighthouse_tpu.store.hot_cold import HotColdDB
+from lighthouse_tpu.store.kv import (
+    JOURNAL_KEY,
+    AtomicBatch,
+    Column,
+    FileStore,
+    MemoryStore,
+    decode_batch,
+    encode_batch,
+    recover_journal,
+)
+from lighthouse_tpu.types import ChainSpec, MINIMAL, interop_genesis_state
+
+SPEC = ChainSpec.interop()
+EPOCH = MINIMAL.slots_per_epoch
+
+
+@pytest.fixture(autouse=True)
+def fake_crypto():
+    set_backend("fake")
+    yield
+    set_backend("jax_tpu")
+
+
+# --- helpers ----------------------------------------------------------------
+
+
+def kv_dump(kv) -> dict:
+    """Backend-agnostic logical snapshot: {column: {key: value}}, empty
+    columns (and the transient journal column) omitted."""
+    out = {}
+    for name in vars(Column):
+        if name.startswith("_") or name == "JOURNAL":
+            continue
+        col = getattr(Column, name)
+        entries = {key: kv.get(col, key) for key in kv.keys(col)}
+        if entries:
+            out[col] = entries
+    return out
+
+
+def mem_copy(kv) -> MemoryStore:
+    out = MemoryStore()
+    for col, entries in kv._data.items():
+        for key, value in entries.items():
+            out.put(col, key, value)
+    return out
+
+
+class RecordingStore(MemoryStore):
+    """Capture (pre-image, ops) of every atomic batch for matrix replay."""
+
+    def __init__(self):
+        super().__init__()
+        self.batches: list = []
+
+    def do_atomically(self, ops):
+        ops = list(ops)
+        self.batches.append((mem_copy(self), ops))
+        super().do_atomically(ops)
+
+
+def crash_matrix(pre: MemoryStore, ops: list, open_db):
+    """Crash a batch at every kv op index (journal write, each applied
+    op, commit-marker delete) with every death mode; after reopen the
+    store must equal the pre or post image exactly, and a rolled-back
+    batch must converge when re-applied. `open_db(kv)` reopens the store
+    (running recovery) and returns a HotColdDB for fsck."""
+    pre_dump = kv_dump(pre)
+    post = mem_copy(pre)
+    post.do_atomically(ops)
+    post_dump = kv_dump(post)
+    assert post_dump != pre_dump, "batch under test must change the store"
+    total = len(ops) + 2  # journal put + applied ops + journal delete
+    outcomes = {"pre": 0, "post": 0}
+    for crash_at in range(total):
+        for action in (CRASH, TORN, AFTER):
+            store = mem_copy(pre)
+            wrapped = CrashingStore(store, CrashPlan(crash_at=crash_at,
+                                                     action=action))
+            with pytest.raises(InjectedCrash):
+                wrapped.do_atomically(ops)
+            db = open_db(store)  # reopen == journal recovery
+            assert run_fsck(db) == [], (crash_at, action)
+            final = kv_dump(store)
+            assert final in (pre_dump, post_dump), (
+                f"torn state after crash at op {crash_at} ({action})"
+            )
+            if final == pre_dump:
+                outcomes["pre"] += 1
+                # rollback converges: re-running the batch lands exactly
+                # on the committed image
+                store.do_atomically(ops)
+                assert kv_dump(store) == post_dump
+            else:
+                outcomes["post"] += 1
+    # both recovery outcomes must actually occur across the matrix
+    assert outcomes["pre"] > 0 and outcomes["post"] > 0, outcomes
+    return post_dump
+
+
+def migration_batches(kv: RecordingStore):
+    return [
+        (pre, ops)
+        for pre, ops in kv.batches
+        if any(
+            op == "put" and col == Column.CHAIN and key == b"split_slot"
+            for op, col, key, _v in ops
+        )
+    ]
+
+
+# --- journal protocol (backend-level) ---------------------------------------
+
+
+class TestJournalProtocol:
+    OPS = [
+        ("put", Column.BLOCK, b"\x01" * 32, b"block-one"),
+        ("put", Column.CHAIN, b"split_slot", b"\x00" * 8),
+        ("delete", Column.STATE, b"\x02" * 32, None),
+        ("put", Column.CHAIN, b"head_block_root", b"\x03" * 32),
+    ]
+
+    def _seeded(self, kv):
+        kv.put(Column.STATE, b"\x02" * 32, b"doomed")
+        kv.put(Column.CHAIN, b"head_block_root", b"\x04" * 32)
+        return kv
+
+    @pytest.mark.parametrize("make", [
+        MemoryStore,
+        lambda: FileStore.__new__(FileStore),  # replaced in test for tmp_path
+    ], ids=["memory", "file"])
+    def test_commit_leaves_no_journal(self, make, tmp_path):
+        kv = make()
+        if isinstance(kv, FileStore):
+            kv.__init__(str(tmp_path / "db"), durable=False)
+        self._seeded(kv)
+        kv.do_atomically(self.OPS)
+        assert kv.get(Column.JOURNAL, JOURNAL_KEY) is None
+        assert kv.get(Column.BLOCK, b"\x01" * 32) == b"block-one"
+        assert kv.get(Column.STATE, b"\x02" * 32) is None
+        assert kv.get(Column.CHAIN, b"head_block_root") == b"\x03" * 32
+
+    def test_encode_decode_roundtrip_and_torn_blob(self):
+        blob = encode_batch(self.OPS)
+        ops = decode_batch(blob)
+        assert ops == [
+            ("put", Column.BLOCK, b"\x01" * 32, b"block-one"),
+            ("put", Column.CHAIN, b"split_slot", b"\x00" * 8),
+            ("delete", Column.STATE, b"\x02" * 32, None),
+            ("put", Column.CHAIN, b"head_block_root", b"\x03" * 32),
+        ]
+        # every truncation of the blob is detected as torn
+        for cut in range(len(blob)):
+            assert decode_batch(blob[:cut]) is None
+        # bitflip inside the payload fails the checksum
+        flipped = bytearray(blob)
+        flipped[-1] ^= 0x40
+        assert decode_batch(bytes(flipped)) is None
+
+    def test_invalid_op_raises_before_any_write(self):
+        kv = MemoryStore()
+        with pytest.raises(ValueError, match="unknown batch op"):
+            kv.do_atomically([("upsert", Column.BLOCK, b"k", b"v")])
+        assert kv_dump(kv) == {}
+
+    def test_empty_batch_writes_nothing(self):
+        kv = MemoryStore()
+        kv.do_atomically([])
+        assert kv_dump(kv) == {}
+
+    @pytest.mark.crash
+    @pytest.mark.parametrize("backend", ["memory", "file"])
+    def test_crash_matrix_small_batch(self, backend, tmp_path):
+        """Every op index x every death mode on both journaled backends:
+        recovery yields exactly pre or post, never a torn mix."""
+        if backend == "memory":
+            pre = self._seeded(MemoryStore())
+            pre_dump = kv_dump(pre)
+            total = len(self.OPS) + 2
+            for crash_at in range(total):
+                for action in (CRASH, TORN, AFTER):
+                    store = mem_copy(pre)
+                    wrapped = CrashingStore(
+                        store, CrashPlan(crash_at=crash_at, action=action)
+                    )
+                    with pytest.raises(InjectedCrash):
+                        wrapped.do_atomically(self.OPS)
+                    recover_journal(store)
+                    post = mem_copy(pre)
+                    post.do_atomically(self.OPS)
+                    assert kv_dump(store) in (pre_dump, kv_dump(post))
+        else:
+            total = len(self.OPS) + 2
+            n = 0
+            for crash_at in range(total):
+                for action in (CRASH, TORN, AFTER):
+                    fs = FileStore(
+                        str(tmp_path / f"db-{crash_at}-{action}"),
+                        durable=False,
+                    )
+                    self._seeded(fs)
+                    pre_dump = kv_dump(fs)
+                    wrapped = CrashingStore(
+                        fs, CrashPlan(crash_at=crash_at, action=action)
+                    )
+                    with pytest.raises(InjectedCrash):
+                        wrapped.do_atomically(self.OPS)
+                    recover_journal(fs)
+                    assert fs.get(Column.JOURNAL, JOURNAL_KEY) is None
+                    final = kv_dump(fs)
+                    if final == pre_dump:
+                        fs.do_atomically(self.OPS)
+                        final = kv_dump(fs)
+                    post = FileStore(str(tmp_path / f"post-{n}"),
+                                     durable=False)
+                    self._seeded(post)
+                    post.do_atomically(self.OPS)
+                    assert final == kv_dump(post)
+                    n += 1
+
+    def test_crash_plan_determinism(self):
+        """Same seed => same crash schedule (the FaultPlan contract)."""
+        runs = []
+        for _ in range(2):
+            plan = CrashPlan(seed=1234, crash_rate=0.15, action=TORN)
+            for _i in range(60):
+                plan.decide("put")
+                plan.crashed = False  # keep drawing past the first death
+            runs.append(plan.events.events)
+        assert runs[0] == runs[1]
+        assert runs[0], "no crashes drawn at this rate/seed"
+
+
+# --- the batch matrices over real node workloads ----------------------------
+
+
+@pytest.fixture(scope="module")
+def finalized_recording():
+    """A finalized chain over a RecordingStore: every atomic batch the
+    node wrote (imports, migrations) is captured with its pre-image."""
+    from lighthouse_tpu.harness import BeaconChainHarness
+
+    set_backend("fake")
+    kv = RecordingStore()
+    h = BeaconChainHarness(16, MINIMAL, sign=False, kv=kv)
+    h.store.slots_per_restore_point = EPOCH
+    h.extend_chain(5 * EPOCH, attest=True)
+    assert h.store.split_slot >= 2 * EPOCH, "chain never finalized"
+    return h, kv
+
+
+def _open_minimal(spec):
+    def open_db(store):
+        return HotColdDB(
+            store, MINIMAL, spec, slots_per_restore_point=EPOCH
+        )
+
+    return open_db
+
+
+@pytest.mark.crash
+class TestMigrationCrashMatrix:
+    def test_live_store_is_fsck_clean(self, finalized_recording):
+        h, _kv = finalized_recording
+        assert run_fsck(h.store) == []
+
+    def test_crash_at_every_op_of_migration(self, finalized_recording):
+        """The acceptance matrix: a crash at EVERY kv op index of the
+        last hot->cold migration batch recovers to an fsck-clean store
+        equal to the pre or post image, and the chain resumes with
+        bit-identical head/finalized roots."""
+        h, kv = finalized_recording
+        pre, ops = migration_batches(kv)[-1]
+        assert len(ops) > 20, "migration batch suspiciously small"
+        crash_matrix(pre, ops, _open_minimal(h.spec))
+
+    def test_resumed_chain_roots_bit_identical(self, finalized_recording):
+        """End-to-end resume across a crash-recovered migration: sample
+        crash points (first, an interior op, the commit delete), reopen,
+        and FromStore must land on the same head/finalized roots as a
+        crash-free run."""
+        h, kv = finalized_recording
+        pre, ops = migration_batches(kv)[-1]
+        clean = mem_copy(pre)
+        clean.do_atomically(ops)
+        reference = BeaconChain.from_store(
+            HotColdDB(clean, MINIMAL, h.spec, slots_per_restore_point=EPOCH),
+            MINIMAL,
+            h.spec,
+        )
+        total = len(ops) + 2
+        for crash_at in (0, 1, total // 2, total - 1):
+            store = mem_copy(pre)
+            wrapped = CrashingStore(store, CrashPlan(crash_at=crash_at))
+            with pytest.raises(InjectedCrash):
+                wrapped.do_atomically(ops)
+            db = HotColdDB(
+                store, MINIMAL, h.spec, slots_per_restore_point=EPOCH
+            )
+            chain = BeaconChain.from_store(db, MINIMAL, h.spec)
+            assert chain.head_root == reference.head_root
+            assert (
+                chain.head_state.tree_hash_root()
+                == reference.head_state.tree_hash_root()
+            )
+            assert (
+                chain.head_state.finalized_checkpoint.epoch
+                == reference.head_state.finalized_checkpoint.epoch
+            )
+
+    def test_torn_migration_journal_rolls_back(self, finalized_recording):
+        """A torn intent write (half the journal blob on disk) must roll
+        back: the split does not advance, and fsck stays clean."""
+        h, kv = finalized_recording
+        pre, ops = migration_batches(kv)[-1]
+        store = mem_copy(pre)
+        pre_dump = kv_dump(store)
+        wrapped = CrashingStore(store, CrashPlan(crash_at=0, action=TORN))
+        with pytest.raises(InjectedCrash):
+            wrapped.do_atomically(ops)
+        assert store.get(Column.JOURNAL, JOURNAL_KEY) is not None
+        db = HotColdDB(store, MINIMAL, h.spec, slots_per_restore_point=EPOCH)
+        assert db.journal_recovery == "rolled_back"
+        assert kv_dump(store) == pre_dump
+        assert run_fsck(db) == []
+
+
+@pytest.mark.crash
+class TestGenesisInitCrashMatrix:
+    def test_crash_at_every_op_of_genesis_init(self):
+        """Genesis init (schema stamp + the init batch) crashed at every
+        kv op index: reopening yields an fsck-clean store, and re-running
+        init lands bit-identically on the crash-free image."""
+        genesis = interop_genesis_state(16, MINIMAL, SPEC, genesis_time=600)
+
+        def init(kv):
+            db = HotColdDB(kv, MINIMAL, SPEC)
+            chain = BeaconChain(db, genesis, MINIMAL, SPEC)
+            return db, chain
+
+        clean_kv = MemoryStore()
+        _, reference = init(clean_kv)
+        clean_dump = kv_dump(clean_kv)
+
+        counting = CrashPlan()
+        init(CrashingStore(MemoryStore(), counting))
+        total = counting.ops
+        assert total >= 8, f"expected a real genesis batch, saw {total} ops"
+
+        for crash_at in range(total):
+            for action in (CRASH, TORN, AFTER):
+                inner = MemoryStore()
+                plan = CrashPlan(crash_at=crash_at, action=action)
+                with pytest.raises(InjectedCrash):
+                    init(CrashingStore(inner, plan))
+                # reopen + fsck: recovery must leave a fresh-or-complete
+                # store, never a head pointing at a missing state
+                db = HotColdDB(inner, MINIMAL, SPEC)
+                assert run_fsck(db) == [], (crash_at, action)
+                # a restarted node re-runs init; it must converge
+                _, chain = init(inner)
+                assert chain.head_root == reference.head_root
+                assert kv_dump(inner) == clean_dump, (crash_at, action)
+
+
+@pytest.mark.crash
+class TestSchemaMigrationCrashMatrix:
+    def _v1_store(self):
+        from lighthouse_tpu.store.metadata import set_schema_version
+
+        kv = MemoryStore()
+        for i in range(3):
+            kv.put(Column.BLOCK, bytes([i]) * 32, b"\xaa raw-v1-ssz %d" % i)
+        kv.put(Column.FREEZER_BLOCK, b"\x77" * 32, b"\xbb raw frozen")
+        set_schema_version(kv, 1)
+        return kv
+
+    def test_crash_at_every_op_of_v1_to_v2(self):
+        """Crash between any two ops of the migration batch — including
+        "between the rewrite and the version stamp", which is now inside
+        the same batch — and reopening converges to v2."""
+        from lighthouse_tpu.store.metadata import (
+            CURRENT_SCHEMA_VERSION,
+            ensure_schema,
+            get_schema_version,
+        )
+
+        clean = self._v1_store()
+        assert ensure_schema(clean, MINIMAL) == [(1, 2)]
+        clean_dump = kv_dump(clean)
+
+        counting = CrashPlan()
+        ensure_schema(CrashingStore(self._v1_store(), counting), MINIMAL)
+        total = counting.ops
+        assert total == 4 + 1 + 2  # 4 rewrites + stamp, journaled
+
+        for crash_at in range(total):
+            for action in (CRASH, TORN, AFTER):
+                inner = self._v1_store()
+                plan = CrashPlan(crash_at=crash_at, action=action)
+                with pytest.raises(InjectedCrash):
+                    ensure_schema(CrashingStore(inner, plan), MINIMAL)
+                # reopen order matters: recovery first, then re-migrate
+                recover_journal(inner)
+                ensure_schema(inner, MINIMAL)
+                assert get_schema_version(inner) == CURRENT_SCHEMA_VERSION
+                assert kv_dump(inner) == clean_dump, (crash_at, action)
+
+    def test_half_applied_rewrite_converges(self):
+        """Manually apply a PREFIX of the migration ops (a half-applied
+        rewrite with no journal) and re-run: idempotent convergence."""
+        from lighthouse_tpu.store.metadata import (
+            _migrate_v1_to_v2,
+            ensure_schema,
+        )
+
+        clean = self._v1_store()
+        ensure_schema(clean, MINIMAL)
+        kv = self._v1_store()
+        ops = _migrate_v1_to_v2(kv, MINIMAL)
+        for op, col, key, value in ops[: len(ops) // 2]:
+            kv.put(col, key, value)
+        ensure_schema(kv, MINIMAL)
+        assert kv_dump(kv) == kv_dump(clean)
+
+
+@pytest.mark.crash
+class TestPrunePayloadsCrashMatrix:
+    def test_crash_at_every_op_of_prune(self):
+        """Payload pruning is one batch: any crash index recovers to the
+        fully-pruned or fully-unpruned image (roots identical anyway)."""
+        from lighthouse_tpu.execution_layer import (
+            ExecutionLayer,
+            MockExecutionEngine,
+        )
+        from lighthouse_tpu.harness import BeaconChainHarness
+        from lighthouse_tpu.types import types_for
+
+        t = types_for(MINIMAL)
+        el = ExecutionLayer(MockExecutionEngine(t))
+        spec = ChainSpec.interop(altair_fork_epoch=1, bellatrix_fork_epoch=2)
+        kv = RecordingStore()
+        h = BeaconChainHarness(
+            16, MINIMAL, spec, sign=False, execution_layer=el, kv=kv
+        )
+        h.extend_chain(2 * EPOCH + 3)
+        assert h.chain.head_state.fork_name == "bellatrix"
+        batches_before = len(kv.batches)
+        n = h.store.prune_payloads(
+            before_slot=int(h.chain.head_state.slot) + 1
+        )
+        assert n >= 3
+        pre, ops = kv.batches[batches_before]
+        assert len(ops) == n
+        crash_matrix(pre, ops, _open_minimal(spec))
+
+
+# --- FileStore durability ---------------------------------------------------
+
+
+class TestFileStoreDurability:
+    def test_put_fsyncs_file_and_directory(self, tmp_path, monkeypatch):
+        synced = []
+        real_fsync = os.fsync
+
+        def counting_fsync(fd):
+            synced.append(fd)
+            return real_fsync(fd)
+
+        monkeypatch.setattr(os, "fsync", counting_fsync)
+        fs = FileStore(str(tmp_path / "durable"))
+        fs.put(Column.CHAIN, b"head", b"\x01" * 32)
+        assert len(synced) >= 2, "expected file + directory fsync"
+        synced.clear()
+        fs.delete(Column.CHAIN, b"head")
+        assert len(synced) >= 1, "expected directory fsync after delete"
+
+    def test_durable_false_escape_hatch_never_syncs(
+        self, tmp_path, monkeypatch
+    ):
+        synced = []
+        monkeypatch.setattr(os, "fsync", lambda fd: synced.append(fd))
+        fs = FileStore(str(tmp_path / "fast"), durable=False)
+        fs.put(Column.CHAIN, b"head", b"\x01" * 32)
+        fs.delete(Column.CHAIN, b"head")
+        fs.do_atomically([("put", Column.CHAIN, b"k", b"v")])
+        assert synced == []
+
+
+# --- corrupt-head fallback --------------------------------------------------
+
+
+class TestCorruptHeadFallback:
+    def test_corrupt_head_falls_back_to_finalized(
+        self, finalized_recording, capsys
+    ):
+        h, kv = finalized_recording
+        store_kv = mem_copy(kv)
+        db = HotColdDB(
+            store_kv, MINIMAL, h.spec, slots_per_restore_point=EPOCH
+        )
+        fin_root = db.get_chain_item(b"finalized_block_root")
+        assert fin_root is not None, "migration persisted no finalized root"
+        db.put_chain_item(b"head_block_root", b"\xde\xad" * 16)
+        chain = BeaconChain.from_store(db, MINIMAL, h.spec)
+        assert chain.head_root == fin_root
+        err = capsys.readouterr().err
+        assert "head pointer corrupt" in err
+        assert "falling back" in err
+
+    def test_missing_head_state_row_falls_back(self, finalized_recording):
+        h, kv = finalized_recording
+        store_kv = mem_copy(kv)
+        db = HotColdDB(
+            store_kv, MINIMAL, h.spec, slots_per_restore_point=EPOCH
+        )
+        head_state_root = db.get_chain_item(b"head_state_root")
+        store_kv.delete(Column.STATE, head_state_root)
+        store_kv.delete(Column.STATE_SUMMARY, head_state_root)
+        chain = BeaconChain.from_store(db, MINIMAL, h.spec)
+        assert chain.head_root == db.get_chain_item(b"finalized_block_root")
+
+    def test_no_fallback_still_raises(self):
+        from lighthouse_tpu.chain.beacon_chain import BlockError
+
+        kv = MemoryStore()
+        db = HotColdDB(kv, MINIMAL, SPEC)
+        with pytest.raises(BlockError, match="no persisted chain"):
+            BeaconChain.from_store(db, MINIMAL, SPEC)
+
+
+# --- fsck detects real corruption -------------------------------------------
+
+
+class TestFsckDetectsCorruption:
+    def test_orphan_journal_reported(self, finalized_recording):
+        h, kv = finalized_recording
+        store_kv = mem_copy(kv)
+        db = HotColdDB(
+            store_kv, MINIMAL, h.spec, slots_per_restore_point=EPOCH
+        )
+        store_kv.put(Column.JOURNAL, JOURNAL_KEY, b"garbage")
+        issues = run_fsck(db)
+        assert any(i.check == "journal" for i in issues)
+
+    def test_open_time_recovery_clears_orphan_journal(
+        self, finalized_recording
+    ):
+        h, kv = finalized_recording
+        store_kv = mem_copy(kv)
+        store_kv.put(Column.JOURNAL, JOURNAL_KEY, b"garbage")
+        db = HotColdDB(
+            store_kv, MINIMAL, h.spec, slots_per_restore_point=EPOCH
+        )
+        assert db.journal_recovery == "rolled_back"
+        assert run_fsck(db) == []
+
+    def test_block_root_hole_reported(self, finalized_recording):
+        import struct as _struct
+
+        h, kv = finalized_recording
+        store_kv = mem_copy(kv)
+        db = HotColdDB(
+            store_kv, MINIMAL, h.spec, slots_per_restore_point=EPOCH
+        )
+        store_kv.delete(Column.FREEZER_BLOCK_ROOTS, _struct.pack(">Q", 0))
+        issues = run_fsck(db)
+        assert any(i.check == "block-roots" for i in issues)
+
+    def test_missing_restore_point_reported(self, finalized_recording):
+        from lighthouse_tpu.store.kv import slot_key
+
+        h, kv = finalized_recording
+        store_kv = mem_copy(kv)
+        db = HotColdDB(
+            store_kv, MINIMAL, h.spec, slots_per_restore_point=EPOCH
+        )
+        store_kv.delete(Column.FREEZER_STATE, slot_key(EPOCH))
+        issues = run_fsck(db)
+        assert any(i.check == "restore-points" for i in issues)
+
+    def test_dangling_head_mapping_reported(self, finalized_recording):
+        h, kv = finalized_recording
+        store_kv = mem_copy(kv)
+        db = HotColdDB(
+            store_kv, MINIMAL, h.spec, slots_per_restore_point=EPOCH
+        )
+        db.delete_chain_item(
+            b"block_post_state:" + db.get_chain_item(b"head_block_root")
+        )
+        issues = run_fsck(db)
+        assert any(i.check == "head" for i in issues)
+
+
+# --- db fsck / inspect CLI --------------------------------------------------
+
+
+class TestDbCli:
+    def _datadir_with_chain(self, tmp_path):
+        genesis = interop_genesis_state(16, MINIMAL, SPEC, genesis_time=600)
+        fs = FileStore(str(tmp_path / "datadir"), durable=False)
+        db = HotColdDB(fs, MINIMAL, SPEC)
+        BeaconChain(db, genesis, MINIMAL, SPEC)
+        return str(tmp_path / "datadir")
+
+    def test_db_fsck_clean_exit_zero(self, tmp_path, capsys):
+        import json
+
+        from lighthouse_tpu.cli import main
+
+        datadir = self._datadir_with_chain(tmp_path)
+        rc = main(["db", "fsck", "--datadir", datadir])
+        out = json.loads(capsys.readouterr().out)
+        assert rc == 0
+        assert out["clean"] is True
+        assert out["journal_recovery"] == "clean"
+
+    def test_db_fsck_dirty_exit_one(self, tmp_path, capsys):
+        import json
+
+        from lighthouse_tpu.cli import main
+
+        datadir = self._datadir_with_chain(tmp_path)
+        fs = FileStore(datadir, durable=False)
+        fs.delete(Column.CHAIN, b"head_state_root")
+        fs.put(Column.CHAIN, b"head_state_root", b"\x99" * 32)
+        rc = main(["db", "fsck", "--datadir", datadir])
+        out = json.loads(capsys.readouterr().out)
+        assert rc == 1
+        assert out["clean"] is False
+        assert any("head" in i for i in out["issues"])
+
+    def test_db_inspect_reports_journal_and_schema(self, tmp_path, capsys):
+        import json
+
+        from lighthouse_tpu.cli import main
+        from lighthouse_tpu.store.metadata import CURRENT_SCHEMA_VERSION
+
+        datadir = self._datadir_with_chain(tmp_path)
+        rc = main(["db", "inspect", "--datadir", datadir])
+        out = json.loads(capsys.readouterr().out)
+        assert rc == 0
+        assert out["schema_version"] == CURRENT_SCHEMA_VERSION
+        assert out["journal_pending"] is False
+        assert out["columns"]["chain"] >= 5
+
+    def test_db_fsck_recovers_interrupted_batch(self, tmp_path, capsys):
+        import json
+
+        from lighthouse_tpu.cli import main
+
+        datadir = self._datadir_with_chain(tmp_path)
+        fs = FileStore(datadir, durable=False)
+        # plant a committed-but-unapplied journal: fsck's open replays it
+        fs.put(
+            Column.JOURNAL,
+            JOURNAL_KEY,
+            encode_batch([("put", Column.CHAIN, b"marker", b"\x01")]),
+        )
+        rc = main(["db", "fsck", "--datadir", datadir])
+        out = json.loads(capsys.readouterr().out)
+        assert rc == 0
+        assert out["journal_recovery"] == "replayed"
+        assert fs.get(Column.CHAIN, b"marker") == b"\x01"
+
+
+# --- slashing-protection interchange is transactional -----------------------
+
+
+class TestSlashingInterchangeTransactional:
+    GVR = b"\x12" * 32
+
+    def _interchange(self, records):
+        return {
+            "metadata": {
+                "interchange_format_version": "5",
+                "genesis_validators_root": "0x" + self.GVR.hex(),
+            },
+            "data": records,
+        }
+
+    def _record(self, seed, slots=(10, 11), atts=((2, 3),)):
+        return {
+            "pubkey": "0x" + (bytes([seed]) * 48).hex(),
+            "signed_blocks": [
+                {"slot": str(s), "signing_root": "0x" + "ab" * 32}
+                for s in slots
+            ],
+            "signed_attestations": [
+                {
+                    "source_epoch": str(se),
+                    "target_epoch": str(te),
+                    "signing_root": "0x" + "cd" * 32,
+                }
+                for se, te in atts
+            ],
+        }
+
+    def _checkpointed_bytes(self, db, path):
+        db.conn.execute("PRAGMA wal_checkpoint(TRUNCATE)")
+        with open(path, "rb") as f:
+            return f.read()
+
+    def test_malformed_trailing_record_leaves_db_byte_identical(
+        self, tmp_path
+    ):
+        from lighthouse_tpu.validator_client.slashing_protection import (
+            NotSafe,
+            SlashingDatabase,
+        )
+
+        path = str(tmp_path / "slashing.sqlite")
+        db = SlashingDatabase(path)
+        db.import_interchange(
+            self._interchange([self._record(1)]), self.GVR
+        )
+        before = self._checkpointed_bytes(db, path)
+
+        bad = self._interchange([
+            self._record(2),  # a perfectly valid record first...
+            {"pubkey": "0x" + (b"\x03" * 48).hex(),
+             "signed_blocks": [{"slot": "not-an-int"}],
+             "signed_attestations": []},
+        ])
+        with pytest.raises(NotSafe, match="malformed"):
+            db.import_interchange(bad, self.GVR)
+        assert self._checkpointed_bytes(db, path) == before
+        # ...and validator 2's record really was rolled back
+        export = db.export_interchange(self.GVR)
+        pubkeys = {r["pubkey"] for r in export["data"]}
+        assert "0x" + (b"\x02" * 48).hex() not in pubkeys
+
+    def test_slashable_trailing_record_rolls_back_whole_import(
+        self, tmp_path
+    ):
+        from lighthouse_tpu.validator_client.slashing_protection import (
+            NotSafe,
+            SlashingDatabase,
+        )
+
+        path = str(tmp_path / "slashing2.sqlite")
+        db = SlashingDatabase(path)
+        db.import_interchange(
+            self._interchange([self._record(1)]), self.GVR
+        )
+        before = self._checkpointed_bytes(db, path)
+        surrounding = self._record(1, slots=(), atts=((1, 5),))
+        conflict = self._interchange([self._record(4), surrounding])
+        with pytest.raises(NotSafe):
+            db.import_interchange(conflict, self.GVR)
+        assert self._checkpointed_bytes(db, path) == before
+
+    def test_file_backed_db_uses_wal_and_full_sync(self, tmp_path):
+        from lighthouse_tpu.validator_client.slashing_protection import (
+            SlashingDatabase,
+        )
+
+        db = SlashingDatabase(str(tmp_path / "slashing3.sqlite"))
+        mode = db.conn.execute("PRAGMA journal_mode").fetchone()[0]
+        sync = db.conn.execute("PRAGMA synchronous").fetchone()[0]
+        assert mode == "wal"
+        assert sync == 2  # FULL
+
+    def test_memory_db_unaffected(self):
+        from lighthouse_tpu.validator_client.slashing_protection import (
+            SlashingDatabase,
+        )
+
+        db = SlashingDatabase(":memory:")
+        db.import_interchange(self._interchange([self._record(9)]), self.GVR)
+        export = db.export_interchange(self.GVR)
+        assert len(export["data"]) == 1

@@ -771,6 +771,99 @@ def test_tracer_leak_negative_non_jit_method(tmp_path):
     assert "tracer-leak" not in rules_hit(vs)
 
 
+# --- bare-atomic-batch ------------------------------------------------------
+
+
+def test_bare_atomic_batch_positive_two_chain_puts(tmp_path):
+    vs = lint_fixture(
+        tmp_path, "store/thing.py",
+        """
+        from .kv import Column
+        def advance_split(kv, slot, root):
+            kv.put(Column.CHAIN, b"split_slot", slot)
+            kv.put(Column.CHAIN, b"head_block_root", root)
+        """,
+    )
+    assert "bare-atomic-batch" in rules_hit(vs)
+
+
+def test_bare_atomic_batch_positive_put_chain_item_pair(tmp_path):
+    vs = lint_fixture(
+        tmp_path, "chain/thing.py",
+        """
+        def persist_head(store, head, state_root):
+            store.put_chain_item(b"head_block_root", head)
+            store.put_chain_item(b"head_state_root", state_root)
+        """,
+    )
+    assert "bare-atomic-batch" in rules_hit(vs)
+
+
+def test_bare_atomic_batch_positive_mixed_put_delete(tmp_path):
+    vs = lint_fixture(
+        tmp_path, "store/thing.py",
+        """
+        from .kv import Column
+        def swap(kv, root):
+            kv.delete(Column.CHAIN, b"old:" + root)
+            kv.put(Column.CHAIN, b"new:" + root, b"1")
+        """,
+    )
+    assert "bare-atomic-batch" in rules_hit(vs)
+
+
+def test_bare_atomic_batch_negative_staged_batch(tmp_path):
+    vs = lint_fixture(
+        tmp_path, "chain/thing.py",
+        """
+        def persist_head(db, head, state_root):
+            batch = db.batch()
+            batch.stage_chain_item(b"head_block_root", head)
+            batch.stage_chain_item(b"head_state_root", state_root)
+            batch.commit()
+        """,
+    )
+    assert "bare-atomic-batch" not in rules_hit(vs)
+
+
+def test_bare_atomic_batch_negative_single_write(tmp_path):
+    vs = lint_fixture(
+        tmp_path, "store/thing.py",
+        """
+        from .kv import Column
+        def stamp(kv, version):
+            kv.put(Column.CHAIN, b"schema_version", version)
+        """,
+    )
+    assert "bare-atomic-batch" not in rules_hit(vs)
+
+
+def test_bare_atomic_batch_negative_outside_scope(tmp_path):
+    vs = lint_fixture(
+        tmp_path, "eth1/thing.py",
+        """
+        from ..store.kv import Column
+        def persist(kv, a, b):
+            kv.put(Column.CHAIN, b"a", a)
+            kv.put(Column.CHAIN, b"b", b)
+        """,
+    )
+    assert "bare-atomic-batch" not in rules_hit(vs)
+
+
+def test_bare_atomic_batch_negative_other_columns(tmp_path):
+    vs = lint_fixture(
+        tmp_path, "store/thing.py",
+        """
+        from .kv import Column
+        def store_block(kv, root, data, state_root, state):
+            kv.put(Column.BLOCK, root, data)
+            kv.put(Column.STATE, state_root, state)
+        """,
+    )
+    assert "bare-atomic-batch" not in rules_hit(vs)
+
+
 # --- suppressions -----------------------------------------------------------
 
 
@@ -865,7 +958,7 @@ def test_baseline_empty_means_any_violation_is_new():
 
 def test_rule_catalogue_complete():
     """Every rule has an id, a docstring, and appears in the registry."""
-    assert len(ALL_RULES) == 11
+    assert len(ALL_RULES) == 12
     for rule in ALL_RULES:
         assert rule.id and rule.id == rule.id.lower()
         assert rule.__doc__ and rule.id in rule.__doc__.split(":")[0]

@@ -210,6 +210,78 @@ class TestResilienceMetrics:
         assert "# TYPE resilience_endpoint_health_score gauge" in text
 
 
+class TestCrashSafetyMetrics:
+    """The crash-safe store's observable surface (utils/metrics.py):
+    write-ahead journal recovery outcomes and fsck results."""
+
+    def test_journal_replay_counted(self):
+        from lighthouse_tpu.store.hot_cold import HotColdDB
+        from lighthouse_tpu.store.kv import (
+            JOURNAL_KEY,
+            Column,
+            MemoryStore,
+            encode_batch,
+        )
+        from lighthouse_tpu.types import ChainSpec
+        from lighthouse_tpu.utils.metrics import STORE_JOURNAL_REPLAYS
+
+        kv = MemoryStore()
+        kv.put(
+            Column.JOURNAL,
+            JOURNAL_KEY,
+            encode_batch([("put", Column.CHAIN, b"x", b"y")]),
+        )
+        before = STORE_JOURNAL_REPLAYS.value
+        db = HotColdDB(kv, MINIMAL, ChainSpec.interop())
+        assert db.journal_recovery == "replayed"
+        assert STORE_JOURNAL_REPLAYS.value == before + 1
+        assert kv.get(Column.CHAIN, b"x") == b"y"
+
+    def test_journal_rollback_counted(self):
+        from lighthouse_tpu.store.hot_cold import HotColdDB
+        from lighthouse_tpu.store.kv import JOURNAL_KEY, Column, MemoryStore
+        from lighthouse_tpu.types import ChainSpec
+        from lighthouse_tpu.utils.metrics import STORE_JOURNAL_ROLLBACKS
+
+        kv = MemoryStore()
+        kv.put(Column.JOURNAL, JOURNAL_KEY, b"torn half-written intent")
+        before = STORE_JOURNAL_ROLLBACKS.value
+        db = HotColdDB(kv, MINIMAL, ChainSpec.interop())
+        assert db.journal_recovery == "rolled_back"
+        assert STORE_JOURNAL_ROLLBACKS.value == before + 1
+        assert kv.get(Column.JOURNAL, JOURNAL_KEY) is None
+
+    def test_fsck_runs_and_issues_counted(self):
+        from lighthouse_tpu.store.fsck import run_fsck
+        from lighthouse_tpu.store.hot_cold import HotColdDB
+        from lighthouse_tpu.store.kv import JOURNAL_KEY, Column, MemoryStore
+        from lighthouse_tpu.types import ChainSpec
+        from lighthouse_tpu.utils.metrics import (
+            STORE_FSCK_FAILURES,
+            STORE_FSCK_RUNS,
+        )
+
+        db = HotColdDB(MemoryStore(), MINIMAL, ChainSpec.interop())
+        runs, fails = STORE_FSCK_RUNS.value, STORE_FSCK_FAILURES.value
+        assert run_fsck(db) == []
+        assert STORE_FSCK_RUNS.value == runs + 1
+        assert STORE_FSCK_FAILURES.value == fails
+        db.kv.put(Column.JOURNAL, JOURNAL_KEY, b"orphan")
+        assert run_fsck(db)
+        assert STORE_FSCK_RUNS.value == runs + 2
+        assert STORE_FSCK_FAILURES.value > fails
+
+    def test_crash_safety_counters_exposed(self):
+        text = REGISTRY.expose()
+        for name in (
+            "store_journal_replays_total",
+            "store_journal_rollbacks_total",
+            "store_fsck_runs_total",
+            "store_fsck_issues_total",
+        ):
+            assert name in text
+
+
 class TestDuplicateImports:
     def test_duplicate_import_not_double_counted(self):
         from lighthouse_tpu.utils.metrics import REGISTRY as R

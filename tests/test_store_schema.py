@@ -71,11 +71,15 @@ def test_v1_to_v2_block_migration():
     assert got is not None
     assert got.message.tree_hash_root() == root
 
-    # idempotent: re-running the step (crash replay) changes nothing
+    # idempotent: re-running the step (crash replay) changes nothing —
+    # the rewrite is returned as batch ops and already-prefixed rows
+    # produce none
     from lighthouse_tpu.store.metadata import _migrate_v1_to_v2
 
     before = kv.get(Column.BLOCK, root)
-    _migrate_v1_to_v2(kv, MINIMAL)
+    ops = _migrate_v1_to_v2(kv, MINIMAL)
+    assert ops == []
+    kv.do_atomically(ops)
     assert kv.get(Column.BLOCK, root) == before
 
 

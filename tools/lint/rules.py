@@ -763,6 +763,71 @@ class TracerLeakRule:
                         )
 
 
+class BareAtomicBatchRule:
+    """bare-atomic-batch: multi-key CHAIN-column mutations must commit as
+    one atomic batch.
+
+    In ``store/`` and ``chain/``, a function that issues two or more
+    direct CHAIN-column mutations (``kv.put(Column.CHAIN, ...)`` /
+    ``kv.delete(Column.CHAIN, ...)`` / ``put_chain_item(...)``) can be
+    torn by a process crash between them, leaving a database no
+    crash-free execution can produce — a ``split_slot`` without its
+    freezer rows, a head pointer whose state pointer lags. Stage the
+    keys on an ``AtomicBatch`` (``stage``/``stage_chain_item``) and
+    ``commit()`` once: the write-ahead journal then replays or rolls
+    back the whole batch on reopen. The journal plumbing itself
+    (``do_atomically``, ``recover_journal``) is exempt, as are
+    single-key writes and ``delete_chain_item`` cleanups (a lone delete
+    is a complete logical op).
+    """
+
+    id = "bare-atomic-batch"
+
+    _SCOPES = ("store/", "chain/")
+    _EXEMPT = ("do_atomically", "recover_journal")
+
+    @staticmethod
+    def _own_nodes(fn):
+        """Walk a function's body without descending into nested function
+        definitions (their mutation count is judged on their own node)."""
+        stack = list(fn.body)
+        while stack:
+            node = stack.pop()
+            yield node
+            if isinstance(
+                node, (ast.FunctionDef, ast.AsyncFunctionDef, ast.Lambda)
+            ):
+                continue
+            stack.extend(ast.iter_child_nodes(node))
+
+    def check(self, ctx):
+        if not _in_dirs(ctx, self._SCOPES):
+            return
+        for fn in ast.walk(ctx.tree):
+            if not isinstance(fn, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                continue
+            if fn.name in self._EXEMPT:
+                continue
+            hits = 0
+            for node in self._own_nodes(fn):
+                if not isinstance(node, ast.Call):
+                    continue
+                leaf = (_dotted(node.func) or "").split(".")[-1]
+                if leaf == "put_chain_item":
+                    hits += 1
+                elif leaf in ("put", "delete") and node.args:
+                    col = _dotted(node.args[0]) or ""
+                    if "." in col and col.split(".")[-1] == "CHAIN":
+                        hits += 1
+            if hits >= 2:
+                yield ctx.violation(
+                    self.id, fn,
+                    f"'{fn.name}' issues {hits} bare CHAIN-column mutations; "
+                    "stage them on one AtomicBatch and commit() once so a "
+                    "crash cannot tear them",
+                )
+
+
 ALL_RULES = [
     WallClockRule(),
     FloatConsensusRule(),
@@ -775,6 +840,7 @@ ALL_RULES = [
     RetryNoBackoffRule(),
     MutableDefaultRule(),
     TracerLeakRule(),
+    BareAtomicBatchRule(),
 ]
 
 RULES_BY_ID = {r.id: r for r in ALL_RULES}

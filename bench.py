@@ -334,17 +334,40 @@ def child() -> None:
     # through the async VerifyPipeline (double-buffered submit_call), so
     # the artifact carries the pipeline's observable surface — depth,
     # occupancy high-water, batch count — next to the blocking number.
+    # The run also exports a Chrome trace artifact (utils/tracing.py):
+    # pipeline submit/resolve spans on a real wall clock, loadable in
+    # Perfetto next to the JSON number.
+    import random as _random
+
     from lighthouse_tpu.crypto.bls.pipeline import VerifyPipeline
     from lighthouse_tpu.utils import metrics as M
+    from lighthouse_tpu.utils import tracing
+
+    class _PerfClock:
+        # bench is an injection boundary: wall time enters HERE and is
+        # handed to the tracer as an injected clock
+        def now(self):
+            return time.perf_counter()
+
+    tracer = tracing.configure(clock=_PerfClock(), rng=_random.Random(0))
+    trace_path = os.path.join(HERE, ".bench_trace.json")
 
     pipe_batches = int(os.environ.get("BENCH_PIPELINE_BATCHES", "4"))
-    pipe = VerifyPipeline(depth=2)
+    pipe = VerifyPipeline(depth=2)  # spans ride the configured tracer
     t0 = time.perf_counter()
-    futs = [
-        pipe.submit_call(verify_device, *args) for _ in range(pipe_batches)
-    ]
-    pipe_ok = all(f.result() for f in futs)
+    with tracer.span("bench_pipeline", batches=pipe_batches, sets=n_sets):
+        futs = [
+            pipe.submit_call(verify_device, *args)
+            for _ in range(pipe_batches)
+        ]
+        pipe_ok = all(f.result() for f in futs)
     pipe_s = time.perf_counter() - t0
+    try:
+        with open(trace_path, "w") as f:
+            f.write(tracer.dump_json())
+        trace_events = tracer.status()["recorded"]
+    except OSError:
+        trace_path, trace_events = None, 0
 
     _emit(
         {
@@ -370,6 +393,15 @@ def child() -> None:
                 "shard_mesh_devices": int(M.BLS_SHARD_MESH_SIZE.value),
                 "bisection_calls": int(M.BLS_BISECTION_CALLS.value),
             },
+            "device_telemetry": {
+                "compile_cache_misses": int(
+                    M.TPU_COMPILE_CACHE_MISSES.value
+                ),
+                "compile_cache_hits": int(M.TPU_COMPILE_CACHE_HITS.value),
+                "transfer_bytes_total": int(M.TPU_TRANSFER_BYTES.value),
+            },
+            "trace_path": trace_path,
+            "trace_events": trace_events,
         }
     )
 

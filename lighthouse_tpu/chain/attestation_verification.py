@@ -28,6 +28,7 @@ from dataclasses import dataclass
 
 from ..crypto.bls import verify_signature_sets, verify_signature_sets_async
 from ..utils import metrics as M
+from ..utils import tracing
 from ..state_transition.context import ConsensusContext
 from ..state_transition.signature_sets import (
     aggregate_and_proof_signature_set,
@@ -201,7 +202,9 @@ def submit_unaggregated_batch(
     survivors = []
     rejected = []
     batch_seen: set = set()
-    with M.ATTN_BATCH_SETUP_TIMES.time():
+    with M.ATTN_BATCH_SETUP_TIMES.time(), tracing.span(
+        "att_setup", n=len(attestations)
+    ):
         _setup_unaggregated_batch(
             chain, attestations, observed_attesters, ctxt, state,
             get_pubkey, survivors, rejected, batch_seen,
@@ -211,6 +214,9 @@ def submit_unaggregated_batch(
         if survivors
         else None
     )
+    # the submitting span context: complete() may run on another worker
+    # after a DeferredWork hand-off, but its spans stay in this trace
+    submit_ctx = tracing.current()
 
     def complete():
         verified = []
@@ -219,15 +225,18 @@ def submit_unaggregated_batch(
             # the residual wait for the verdict plus any bisection -- the
             # worker-visible cost -- not raw device time, which overlaps
             # the next batch's marshalling (see utils/metrics.py help)
-            with M.ATTN_BATCH_VERIFY_TIMES.time():
+            with M.ATTN_BATCH_VERIFY_TIMES.time(), tracing.span(
+                "att_verify_wait", parent=submit_ctx, n=len(survivors)
+            ):
                 batch_ok = future.result()
                 if not batch_ok:
                     # bisection fallback: O(k log n) backend calls
                     # isolate the k poisoned items (vs batch.rs:122-133
                     # O(n))
-                    ok_items, bad_items = bisect_batch_failures(
-                        survivors, lambda item: [item[1]]
-                    )
+                    with tracing.span("att_bisect", n=len(survivors)):
+                        ok_items, bad_items = bisect_batch_failures(
+                            survivors, lambda item: [item[1]]
+                        )
             if batch_ok:
                 ok_items = survivors
             else:
@@ -310,23 +319,10 @@ def _early_checks_aggregate(
     return agg_root
 
 
-def submit_aggregate_batch(
-    chain,
-    signed_aggregates,
-    observed_aggregates,
-    observed_aggregators,
-    ctxt: ConsensusContext | None = None,
-) -> PendingBatch:
-    """Phase 1 of the aggregate-and-proof batch: early checks, THREE
-    sets per item (selection proof, aggregate-and-proof signature,
-    indexed attestation; batch.rs:77-107), one async dispatch."""
-    ctxt = ctxt or ConsensusContext(chain.preset, chain.spec)
-    state = chain.head_state
-    get_pubkey = chain.pubkey_cache.getter(state)
-
-    survivors = []
-    rejected = []
-    batch_seen: set = set()
+def _setup_aggregate_batch(
+    chain, signed_aggregates, observed_aggregates, observed_aggregators,
+    ctxt, state, get_pubkey, survivors, rejected, batch_seen,
+):
     for agg in signed_aggregates:
         try:
             agg_root = _early_checks_aggregate(
@@ -358,6 +354,31 @@ def submit_aggregate_batch(
         except (AttestationError, ValueError) as e:
             rejected.append((agg, str(e)))
 
+
+def submit_aggregate_batch(
+    chain,
+    signed_aggregates,
+    observed_aggregates,
+    observed_aggregators,
+    ctxt: ConsensusContext | None = None,
+) -> PendingBatch:
+    """Phase 1 of the aggregate-and-proof batch: early checks, THREE
+    sets per item (selection proof, aggregate-and-proof signature,
+    indexed attestation; batch.rs:77-107), one async dispatch."""
+    ctxt = ctxt or ConsensusContext(chain.preset, chain.spec)
+    state = chain.head_state
+    get_pubkey = chain.pubkey_cache.getter(state)
+
+    survivors = []
+    rejected = []
+    batch_seen: set = set()
+    with tracing.span("agg_setup", n=len(signed_aggregates)):
+        _setup_aggregate_batch(
+            chain, signed_aggregates, observed_aggregates,
+            observed_aggregators, ctxt, state, get_pubkey,
+            survivors, rejected, batch_seen,
+        )
+
     future = (
         verify_signature_sets_async(
             [s for _, sets, _ in survivors for s in sets]
@@ -365,16 +386,23 @@ def submit_aggregate_batch(
         if survivors
         else None
     )
+    submit_ctx = tracing.current()
 
     def complete():
         verified = []
         if survivors:
-            if future.result():
+            with tracing.span(
+                "agg_verify_wait", parent=submit_ctx, n=len(survivors)
+            ):
+                batch_ok = future.result()
+                if not batch_ok:
+                    with tracing.span("agg_bisect", n=len(survivors)):
+                        ok_items, bad_items = bisect_batch_failures(
+                            survivors, lambda item: item[1]
+                        )
+            if batch_ok:
                 ok_items = survivors
             else:
-                ok_items, bad_items = bisect_batch_failures(
-                    survivors, lambda item: item[1]
-                )
                 for item in bad_items:
                     rejected.append((item[0], "invalid signature"))
             for agg, _, indexed in ok_items:

@@ -344,8 +344,11 @@ class BeaconChain:
         into the metrics registry (reference metrics.rs:37-80
         BLOCK_PROCESSING_* family)."""
         from ..utils import metrics as M
+        from ..utils import tracing
 
-        with self.lock, M.BLOCK_PROCESSING_TIMES.time():
+        with self.lock, M.BLOCK_PROCESSING_TIMES.time(), tracing.span(
+            "block_import", slot=int(signed_block.message.slot)
+        ):
             try:
                 block_root, fresh = self._process_block_timed(
                     signed_block, strategy, pre_state
@@ -356,6 +359,11 @@ class BeaconChain:
         if not fresh:
             return block_root  # duplicate: no metrics, no monitor
         M.BLOCKS_IMPORTED.inc()
+        M.observe_slot_delay(
+            M.BLOCK_IMPORTED_DELAY,
+            self.slot_clock,
+            int(signed_block.message.slot),
+        )
         if self.validator_monitor is not None:
             # import time comes from the injected slot clock, so a replay
             # of the same blocks reports the same timings (wallclock rule)
@@ -366,6 +374,7 @@ class BeaconChain:
 
     def _process_block_timed(self, signed_block, strategy, pre_state=None):
         from ..utils import metrics as M
+        from ..utils import tracing
 
         self.on_tick()
         block = signed_block.message
@@ -426,7 +435,9 @@ class BeaconChain:
 
             ctxt.notify_new_payload = _notify
         try:
-            with M.BLOCK_TRANSITION_TIMES.time():
+            with M.BLOCK_TRANSITION_TIMES.time(), tracing.span(
+                "block_transition"
+            ):
                 per_block_processing(
                     state,
                     signed_block,
@@ -458,7 +469,7 @@ class BeaconChain:
                 is PayloadVerificationStatus.VERIFIED
                 else "optimistic"
             )
-        with M.BLOCK_STATE_ROOT_TIMES.time():
+        with M.BLOCK_STATE_ROOT_TIMES.time(), tracing.span("state_root"):
             state_root = cached_root(state)
         if bytes(block.state_root) != state_root:
             raise BlockError("block state_root mismatch")
@@ -490,7 +501,7 @@ class BeaconChain:
         if otb_parent_hash is not None:
             self.optimistic_transition_blocks[block_root] = otb_parent_hash
 
-        with M.BLOCK_FORK_CHOICE_TIMES.time():
+        with M.BLOCK_FORK_CHOICE_TIMES.time(), tracing.span("fork_choice"):
             self._fork_choice_import(
                 signed_block, block_root, state, ctxt,
                 execution_status, execution_block_hash,
@@ -540,6 +551,14 @@ class BeaconChain:
         old_head = self.head_root
         self.recompute_head()
         if self.head_root != old_head:
+            if self.head_root == block_root:
+                from ..utils import metrics as M
+
+                # the just-imported block became the canonical head: the
+                # final slot-relative milestone (beacon_block_delay_head)
+                M.observe_slot_delay(
+                    M.BLOCK_HEAD_DELAY, self.slot_clock, int(block.slot)
+                )
             head_state_root = self.store.get_chain_item(
                 b"block_post_state:" + self.head_root
             )

@@ -153,9 +153,24 @@ class SignatureVerifiedBlock:
 
 def process_gossip_block(chain: BeaconChain, signed_block) -> bytes:
     """The full gossip pipeline in order (gossip_methods.rs:656 -> 927)."""
-    gv = GossipVerifiedBlock.verify(chain, signed_block)
-    sv = SignatureVerifiedBlock.from_gossip_verified(chain, gv)
-    return sv.import_into(chain)
+    from ..utils import metrics as M
+    from ..utils import tracing
+
+    with tracing.span(
+        "gossip_block", slot=int(signed_block.message.slot)
+    ):
+        with tracing.span("block_gossip_verify"):
+            gv = GossipVerifiedBlock.verify(chain, signed_block)
+        with tracing.span("block_signature_verify"):
+            sv = SignatureVerifiedBlock.from_gossip_verified(chain, gv)
+        # every signature checked: the reference's beacon_block_delay_
+        # gossip_verification milestone (slot clock, replayable)
+        M.observe_slot_delay(
+            M.BLOCK_VERIFIED_DELAY,
+            chain.slot_clock,
+            int(signed_block.message.slot),
+        )
+        return sv.import_into(chain)
 
 
 def signature_verify_chain_segment(chain: BeaconChain, blocks) -> list:

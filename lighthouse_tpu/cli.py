@@ -547,6 +547,76 @@ def cmd_db(args):
     return 0
 
 
+# --- tracing (utils/tracing.py export seat) --------------------------------
+
+
+def cmd_trace(args):
+    """Dump a Chrome trace-event JSON (Perfetto-loadable): from a running
+    node's /lighthouse/tracing/dump when --url is given, else from a
+    seeded in-process demo workload driven through the full gossip ->
+    processor -> pipeline hot path."""
+    from .utils import tracing
+
+    if args.url:
+        import urllib.request
+
+        with urllib.request.urlopen(
+            args.url.rstrip("/") + "/lighthouse/tracing/dump", timeout=15
+        ) as r:
+            body = r.read().decode()
+        trace = json.loads(body)  # refuse to write a non-JSON artifact
+        with open(args.out, "w") as f:
+            f.write(body)
+        print(json.dumps({
+            "source": args.url,
+            "events": len(trace.get("traceEvents", [])),
+            "path": args.out,
+        }))
+        return 0
+
+    # demo mode: a deterministic two-node simulator run under the seeded
+    # tracer -- same seed, same trace, byte for byte
+    import random
+
+    from .crypto.bls import set_backend
+    from .network import Simulator
+
+    preset, spec = _spec_preset(args)
+    tracer = tracing.configure(
+        rng=random.Random(args.seed),
+        clock=tracing.StepClock(step=1e-6),
+        capacity=args.capacity,
+    )
+    set_backend("fake")  # the demo traces scheduling, not pairings
+    sim = Simulator(2, args.validators, preset, spec)
+    for slot in range(1, args.slots + 1):
+        sim.run_slot(slot)
+    # run one unaggregated attestation over the subnets too: blocks carry
+    # their attestations in-body, so without this the demo trace would
+    # never show the gossip_attestation lane
+    from .state_transition import clone_state, process_slots
+
+    node0 = sim.nodes[0]
+    head = node0.chain.head_state
+    adv = process_slots(
+        clone_state(head), head.slot + 1, preset, spec
+    )
+    att = sim.producer.make_unaggregated(adv, head.slot, 0, 0)
+    node0.publish_attestation(att, subnet=0)
+    sim.drain()
+    with open(args.out, "w") as f:
+        f.write(tracer.dump_json())
+    status = tracer.status()
+    print(json.dumps({
+        "source": "demo",
+        "slots": args.slots,
+        "events": status["recorded"],
+        "dropped": status["dropped"],
+        "path": args.out,
+    }))
+    return 0
+
+
 # --- dev tools (reference lcli/src/main.rs:54-610) -------------------------
 
 
@@ -765,6 +835,22 @@ def main(argv=None) -> int:
         "written before the stride was persisted in the chain column)",
     )
     db.set_defaults(fn=cmd_db)
+
+    trace = sub.add_parser(
+        "trace", help="dump a Chrome/Perfetto trace from a node or a demo run"
+    )
+    _add_network_args(trace)
+    trace.add_argument("--url", default=None,
+                       help="running node base URL; fetches its "
+                            "/lighthouse/tracing/dump ring")
+    trace.add_argument("--out", default="trace.json")
+    trace.add_argument("--slots", type=int, default=4,
+                       help="demo mode: slots of simulated network to trace")
+    trace.add_argument("--validators", type=int, default=16)
+    trace.add_argument("--seed", type=int, default=0)
+    trace.add_argument("--capacity", type=int, default=65536,
+                       help="span ring size for the demo tracer")
+    trace.set_defaults(fn=cmd_trace)
 
     tools = sub.add_parser("tools", help="dev tools (lcli)")
     _add_network_args(tools)

@@ -31,7 +31,7 @@ import numpy as np
 import jax
 import jax.numpy as jnp
 
-from ....utils import metrics
+from ....utils import metrics, tracing
 from ..tpu import curve as TC
 from ..tpu import hash_to_curve as THC
 from ..tpu import limbs as L
@@ -287,6 +287,32 @@ def _common_table(sets):
     return table
 
 
+# bucketed shapes marshalled so far: the observable face of jax.jit's
+# executable cache -- a NEW bucket means XLA compiles, a seen one reuses
+# the warm executable (the warm-shape contract of _bucket)
+_seen_shape_buckets: set[tuple] = set()
+
+
+def _count_shape_bucket(n_b: int, k_b: int, m_b: int) -> None:
+    # keyed on the bucketed DEVICE-ARG shapes only: the gather and
+    # host-packed paths feed identically-shaped args to the same jit
+    # executables, so switching paths at a warm shape is a cache HIT
+    key = (n_b, k_b, m_b)
+    if key in _seen_shape_buckets:
+        metrics.TPU_COMPILE_CACHE_HITS.inc()
+    else:
+        _seen_shape_buckets.add(key)
+        metrics.TPU_COMPILE_CACHE_MISSES.inc()
+
+
+def _count_transfer(*arrays) -> None:
+    """Host->device traffic of one batch (the np arrays actually shipped;
+    the gather path ships indices, not limb rows)."""
+    total = sum(int(a.nbytes) for a in arrays)
+    metrics.TPU_TRANSFER_BYTES.inc(total)
+    metrics.TPU_MARSHAL_BATCH_BYTES.set(total)
+
+
 def _marshal_batch(sets, seed=None):
     """Host-side marshalling for one batch: shape bucketing, distinct-
     message dedup, limb packing (or device-table index gather), weights.
@@ -323,6 +349,7 @@ def _marshal_batch(sets, seed=None):
         sig[i] = _sig_limbs(s.signature)
 
     table = _common_table(sets)
+    _count_shape_bucket(n_b, k_b, m_b)
     if table is not None:
         # Steady-state marshaling (validator_pubkey_cache.rs:10-23):
         # host->device traffic is validator INDICES; limb rows are gathered
@@ -341,6 +368,7 @@ def _marshal_batch(sets, seed=None):
         pk_dev = jnp.where(
             jnp.asarray(mask)[..., None, None], rows, jnp.asarray(_INF_G1)
         )
+        pk_traffic = (idx, mask)
     else:
         metrics.BLS_GATHER_MISSES.inc()
         pk = np.broadcast_to(_INF_G1, (n_b, k_b, 3, W)).copy()
@@ -348,6 +376,7 @@ def _marshal_batch(sets, seed=None):
             for j, key in enumerate(s.pubkeys):
                 pk[i, j] = _pk_limbs(key)
         pk_dev = jnp.asarray(pk)
+        pk_traffic = (pk,)
 
     rng = np.random.default_rng(seed)
     scalars = np.zeros((n_b, 2), np.uint32)
@@ -356,6 +385,7 @@ def _marshal_batch(sets, seed=None):
 
     real = np.zeros((n_b,), bool)
     real[:n] = True
+    _count_transfer(u, h_idx, sig, scalars, real, *pk_traffic)
 
     return (
         jnp.asarray(u),
@@ -396,27 +426,30 @@ def dispatch_verify_signature_sets(sets, seed=None):
     path already decided the batch. The pipeline (crypto/bls/pipeline.py)
     overlaps the next batch's marshalling with this batch's device work.
     """
-    args = _marshal_batch(sets, seed=seed)
+    with tracing.span("bls_marshal", sets=len(sets)):
+        args = _marshal_batch(sets, seed=seed)
     if args is None:
         return False
     u, h_idx, pk_dev, sig, scalars, real = args
 
     n_b = int(real.shape[0])
-    threshold = _shard_min_sets()
-    if threshold and n_b >= threshold and len(jax.devices()) > 1:
-        # Multi-chip hot path: shard the per-set axis over the device
-        # mesh; a chip fault shrinks the mesh over survivors (per-device
-        # breakers) and raises MeshEmpty only when no device is usable --
-        # which the FallbackBackend degrades to the cpu oracle.
-        return _mesh_verifier().verify(
-            (jnp.take(u, h_idx, axis=0), pk_dev, sig, scalars, real)
-        )
-    if os.environ.get("LIGHTHOUSE_TPU_MONOLITH") == "1":
-        # the monolithic program takes per-set draws (no dedup axis)
-        return verify_jit(
-            jnp.take(u, h_idx, axis=0), pk_dev, sig, scalars, real
-        )
-    return verify_device(u, h_idx, pk_dev, sig, scalars, real)
+    with tracing.span("bls_dispatch", bucket=n_b):
+        threshold = _shard_min_sets()
+        if threshold and n_b >= threshold and len(jax.devices()) > 1:
+            # Multi-chip hot path: shard the per-set axis over the device
+            # mesh; a chip fault shrinks the mesh over survivors (per-
+            # device breakers) and raises MeshEmpty only when no device
+            # is usable -- which the FallbackBackend degrades to the cpu
+            # oracle.
+            return _mesh_verifier().verify(
+                (jnp.take(u, h_idx, axis=0), pk_dev, sig, scalars, real)
+            )
+        if os.environ.get("LIGHTHOUSE_TPU_MONOLITH") == "1":
+            # the monolithic program takes per-set draws (no dedup axis)
+            return verify_jit(
+                jnp.take(u, h_idx, axis=0), pk_dev, sig, scalars, real
+            )
+        return verify_device(u, h_idx, pk_dev, sig, scalars, real)
 
 
 def verify_signature_sets(sets, seed=None) -> bool:
@@ -500,6 +533,7 @@ class PubkeyTable:
             padded = np.broadcast_to(_INF_G1, (b, 3, W)).copy()
             padded[:n] = self._host
             self._dev = jnp.asarray(padded)
+            metrics.TPU_PUBKEY_TABLE_BYTES.set(padded.nbytes)
         return self._dev
 
     def gather(self, indices):

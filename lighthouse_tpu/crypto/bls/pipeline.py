@@ -38,7 +38,7 @@ from __future__ import annotations
 
 from collections import deque
 
-from ...utils import metrics
+from ...utils import metrics, tracing
 
 
 class PipelineError(RuntimeError):
@@ -54,7 +54,7 @@ class VerifyFuture:
     """Handle to one submitted batch; ``result()`` blocks (resolving any
     earlier in-flight batches first) and returns the batch verdict."""
 
-    __slots__ = ("batch_id", "_pipeline", "_state", "_value", "_error")
+    __slots__ = ("batch_id", "_pipeline", "_state", "_value", "_error", "_ctx")
 
     def __init__(self, batch_id: int, pipeline: "VerifyPipeline"):
         self.batch_id = batch_id
@@ -62,6 +62,10 @@ class VerifyFuture:
         self._state = _PENDING
         self._value = None
         self._error = None
+        # span context captured at submit: resolution re-attaches it so
+        # the resolve span nests under the submitting span even when a
+        # different worker (or a later backpressure wait) materialises it
+        self._ctx = None
 
     def done(self) -> bool:
         """True once ``result()`` would return without a device wait
@@ -119,6 +123,12 @@ class VerifyPipeline:
         if self.events is not None:
             self.events.record(kind, batch=batch)
 
+    def tracer(self):
+        # the PROCESS tracer, looked up per call: configure() swaps
+        # apply everywhere at once, and per-pipeline tracers would split
+        # submit/resolve spans from the worker spans around them
+        return tracing.default_tracer()
+
     def _active_backend(self):
         if self._backend is not None:
             return self._backend
@@ -172,12 +182,15 @@ class VerifyPipeline:
         while len(self._inflight) >= self.depth:
             self._resolve_one()
         self._record("pipeline_marshal", fut.batch_id)
-        try:
-            produce(fut)
-        except Exception as e:  # noqa: BLE001 -- the future carries the
-            # backend/device fault to result(), exactly where the sync
-            # path would have raised it; nothing is swallowed
-            fut._error, fut._state = e, _DISPATCHED
+        tracer = self.tracer()
+        with tracer.span("pipeline_submit", batch=fut.batch_id):
+            fut._ctx = tracer.current()
+            try:
+                produce(fut)
+            except Exception as e:  # noqa: BLE001 -- the future carries
+                # the backend/device fault to result(), exactly where the
+                # sync path would have raised it; nothing is swallowed
+                fut._error, fut._state = e, _DISPATCHED
         self._record("pipeline_dispatch", fut.batch_id)
         metrics.BLS_PIPELINE_BATCHES.inc()
         if fut._state == _RESOLVED:  # structural early-exit: nothing in flight
@@ -196,16 +209,21 @@ class VerifyPipeline:
         if not self._inflight:
             return
         fut = self._inflight.popleft()
-        if fut._error is None:
-            # bool() on the device array is THE host sync point: it blocks
-            # until the enqueued program finishes (a plain bool passes
-            # straight through)
-            try:
-                fut._value = bool(fut._value)
-            except Exception as e:  # noqa: BLE001 -- a device fault can
-                # surface at materialisation rather than dispatch; the
-                # future carries it to result() either way
-                fut._error = e
+        tracer = self.tracer()
+        with tracer.attach(fut._ctx), tracer.span(
+            "pipeline_resolve", batch=fut.batch_id
+        ):
+            if fut._error is None:
+                # bool() on the device array is THE host sync point: it
+                # blocks until the enqueued program finishes (a plain
+                # bool passes straight through)
+                try:
+                    fut._value = bool(fut._value)
+                except Exception as e:  # noqa: BLE001 -- a device fault
+                    # can surface at materialisation rather than
+                    # dispatch; the future carries it to result() either
+                    # way
+                    fut._error = e
         fut._state = _RESOLVED
         self._record("pipeline_resolve", fut.batch_id)
         metrics.BLS_PIPELINE_OCCUPANCY.set(len(self._inflight))

@@ -405,6 +405,22 @@ class BeaconApiServer:
                 if method == "GET" and path == "/metrics":
                     self._send(200, outer.metrics_text(), "text/plain")
                     return
+                if method == "GET" and path == "/lighthouse/tracing/status":
+                    from ..utils.tracing import default_tracer
+
+                    self._send(200, {"data": default_tracer().status()})
+                    return
+                if method == "GET" and path == "/lighthouse/tracing/dump":
+                    # Chrome trace-event JSON: load in Perfetto or
+                    # chrome://tracing (the whole bounded ring)
+                    from ..utils.tracing import default_tracer
+
+                    self._send(
+                        200,
+                        default_tracer().dump_json(),
+                        "application/json",
+                    )
+                    return
                 if method == "GET" and path == "/eth/v1/events":
                     self._send(
                         200,

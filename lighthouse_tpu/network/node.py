@@ -24,6 +24,8 @@ from ..pool import (
 )
 from ..processor import BeaconProcessor, DeferredWork
 from ..types import compute_epoch_at_slot, compute_fork_digest
+from ..utils import metrics as M
+from ..utils import tracing
 from .message_bus import MessageBus, topic_name
 from ..chain.sync_committee_verification import (
     ObservedSyncAggregators,
@@ -233,14 +235,27 @@ class NetworkNode:
         )
         if verdict == "duplicate":
             return
+        # the trace's first event + the slot-relative observation delay
+        # (reference beacon_block_delay_gossip): both ride injected clocks
+        tracing.instant("gossip_block_rx", slot=int(block.slot))
+        M.observe_slot_delay(
+            M.BLOCK_OBSERVED_DELAY, self.chain.slot_clock, int(block.slot)
+        )
         self.processor.submit("gossip_block", (signed_block, source))
 
     def _on_gossip_aggregate(self, signed_aggregate, source: str) -> None:
         if not self.is_banned(source):
+            tracing.instant(
+                "gossip_aggregate_rx",
+                slot=int(signed_aggregate.message.aggregate.data.slot),
+            )
             self.processor.submit("gossip_aggregate", (signed_aggregate, source))
 
     def _on_gossip_attestation(self, attestation, source: str) -> None:
         if not self.is_banned(source):
+            tracing.instant(
+                "gossip_attestation_rx", slot=int(attestation.data.slot)
+            )
             self.processor.submit("gossip_attestation", (attestation, source))
 
     def _make_sync_subnet_handler(self, subnet: int):

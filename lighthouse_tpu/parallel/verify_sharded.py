@@ -35,7 +35,7 @@ except ImportError:  # pre-0.6 jax: the experimental namespace. The
 
 from ..crypto.bls.backends.jax_tpu import verify_body, verify_jit
 from ..resilience.primitives import CircuitBreaker, EventLog
-from ..utils import metrics
+from ..utils import metrics, tracing
 
 AXIS = "sets"
 
@@ -274,6 +274,11 @@ class MeshVerifier:
             return self._verify_blocking(args)
         return MeshVerdict(self, args, mesh_devs, out)
 
+    def tracer(self):
+        # the PROCESS tracer (see pipeline.tracer): mesh spans must land
+        # in the same ring as the pipeline spans that dispatched them
+        return tracing.default_tracer()
+
     def _dispatch(self, mesh_devs, args):
         metrics.BLS_SHARD_MESH_SIZE.set(len(mesh_devs))
         # a mesh of one runs the plain single-chip program: same
@@ -284,18 +289,31 @@ class MeshVerifier:
             if len(mesh_devs) == 1
             else self._program(tuple(mesh_devs))
         )
-        return self.executor.run(fn, args, mesh_devs)
+        with self.tracer().span("mesh_dispatch", devices=len(mesh_devs)):
+            return self.executor.run(fn, args, mesh_devs)
+
+    def _record_chip_timing(self, mesh_devs, seconds: float) -> None:
+        """Per-chip shard timing: a sharded batch is one collective, so
+        every participating chip is charged the batch wall (tracer
+        clock); the per-chip labels make a straggling chip visible as a
+        LARGER last-batch wall once the mesh drops it."""
+        for d in mesh_devs:
+            metrics.MESH_CHIP_BATCH_SECONDS.set(str(d.id), seconds)
 
     def _materialize(self, mesh_devs, out, args) -> bool:
         """Block on a dispatched verdict; success/failure lands on the
         participating breakers HERE, because this is where XLA actually
         reports a chip death. A fault re-runs the batch on survivors."""
+        tracer = self.tracer()
+        t0 = tracer.clock.now()
         try:
-            out = jax.block_until_ready(out)
+            with tracer.span("mesh_materialize", devices=len(mesh_devs)):
+                out = jax.block_until_ready(out)
         except Exception as exc:  # noqa: BLE001 -- a chip died between
             # dispatch and materialisation; re-shard the same batch
             self._on_mesh_fault(mesh_devs, exc)
             return self._verify_blocking(args)
+        self._record_chip_timing(mesh_devs, tracer.clock.now() - t0)
         self._record_mesh_success(mesh_devs)
         return bool(out)
 
@@ -314,16 +332,22 @@ class MeshVerifier:
             mesh_devs = self._select_mesh(n_sets, include_recovering=False)
             if not mesh_devs:
                 break
+            tracer = self.tracer()
+            t0 = tracer.clock.now()
             try:
-                out = jax.block_until_ready(
-                    self._dispatch(mesh_devs, args)
-                )
+                with tracer.span(
+                    "mesh_materialize", devices=len(mesh_devs)
+                ):
+                    out = jax.block_until_ready(
+                        self._dispatch(mesh_devs, args)
+                    )
             except Exception as exc:  # noqa: BLE001 -- any failure here
                 # is a device/runtime fault (injected or real);
                 # attribution happens by probing, never by parsing the
                 # exception
                 self._on_mesh_fault(mesh_devs, exc)
                 continue
+            self._record_chip_timing(mesh_devs, tracer.clock.now() - t0)
             self._record_mesh_success(mesh_devs)
             return bool(out)
         raise MeshEmpty(

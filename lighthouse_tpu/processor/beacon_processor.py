@@ -23,6 +23,9 @@ import threading
 from collections import deque
 from dataclasses import dataclass, field
 
+from ..utils import metrics as M
+from ..utils import tracing
+
 
 @dataclass
 class DeferredWork:
@@ -124,8 +127,10 @@ class BeaconProcessor:
         self.max_batch = max_batch
         self.max_workers = max(1, max_workers)
         self.max_inflight = max(1, max_inflight)
-        # FIFO of (queue_name, n_items, deferred) awaiting resolution
-        self._deferred: deque[tuple[str, int, object]] = deque()
+        # FIFO of (queue_name, n_items, deferred, span_ctx) awaiting
+        # resolution; span_ctx re-parents the resume span under the work
+        # span that dispatched the batch (the DeferredWork boundary)
+        self._deferred: deque[tuple[str, int, object, object]] = deque()
         self.journal: list[tuple[str, int]] | None = [] if journal else None
         self.queues = {
             "chain_segment": WorkQueue("chain_segment", 64),
@@ -166,10 +171,29 @@ class BeaconProcessor:
         self.handler_errors: dict[str, int] = {}
         self.last_error: str | None = None
 
+    def tracer(self):
+        # always the PROCESS tracer (tracing.configure() swaps apply
+        # everywhere at once): per-component tracer injection would
+        # fragment one logical trace across rings at the handler seams
+        return tracing.default_tracer()
+
     def submit(self, queue: str, item) -> bool:
+        # items ride the queue with their enqueue stamp AND the clock
+        # that took it (tracer clock, so replays see identical waits):
+        # the wait is always measured in the SUBMITTING clock's timebase,
+        # so a tracing.configure() clock swap mid-flight cannot corrupt
+        # the histogram with cross-clock deltas
+        clock = self.tracer().clock
+        t_enq = clock.now()
         with self._lock:
-            ok = self.queues[queue].push(item)
+            q = self.queues[queue]
+            dropped_before = q.dropped
+            ok = q.push((item, t_enq, clock))
             if ok:
+                if q.dropped == dropped_before:
+                    # a LIFO shed replaces an already-counted item:
+                    # pending depth is unchanged in that case
+                    M.PROCESSOR_PENDING.inc()
                 self._work_available.notify()
             return ok
 
@@ -182,9 +206,23 @@ class BeaconProcessor:
             if name in self.batched:
                 # >=2 queued items repackage into one batch work item
                 # (mod.rs:1098-1139), capped at the device batch size
-                items = q.drain(self.max_batch)
+                stamped = q.drain(self.max_batch)
             else:
-                items = [q.pop()]
+                stamped = [q.pop()]
+            items = [it for it, _, _ in stamped]
+            M.PROCESSOR_PENDING.dec(len(items))
+            # the OLDEST item's wait bounds the batch's scheduling
+            # latency; each stamp resolves against its OWN clock, read
+            # once per distinct clock (>= 0: a swapped-in fresh clock
+            # must never record a negative wait)
+            now_by_clock: dict = {}
+            wait = 0.0
+            for _, t, c in stamped:
+                now = now_by_clock.get(id(c))
+                if now is None:
+                    now = now_by_clock[id(c)] = c.now()
+                wait = max(wait, now - t)
+            M.PROCESSOR_QUEUE_WAIT.observe(max(0.0, wait))
             if self.journal is not None:
                 self.journal.append((name, len(items)))
             return name, items
@@ -203,25 +241,32 @@ class BeaconProcessor:
                 break
             self._complete_deferred(block=True)
         handler = self.handlers.get(name)
+        tracer = self.tracer()
         out = None
-        try:
-            if handler is not None:
-                if name in self.batched:
-                    out = handler(items)
-                else:
-                    out = handler(items[0])
-        # lint: allow[broad-except] -- worker survival boundary: handlers
-        # are arbitrary application callbacks, so the exception type is
-        # unknowable here; the failure is counted per-queue and surfaced
-        # via last_error, never dropped
-        except Exception as exc:  # noqa: BLE001 -- a poisoned work item
-            # must not kill its worker (mod.rs workers are respawned per
-            # task; here the thread persists, so survive and count)
-            self._count_error(name, exc)
+        ctx = None
+        with tracer.span(f"work/{name}", n=len(items)):
+            try:
+                if handler is not None:
+                    if name in self.batched:
+                        out = handler(items)
+                    else:
+                        out = handler(items[0])
+            # lint: allow[broad-except] -- worker survival boundary:
+            # handlers are arbitrary application callbacks, so the
+            # exception type is unknowable here; the failure is counted
+            # per-queue and surfaced via last_error, never dropped
+            except Exception as exc:  # noqa: BLE001 -- a poisoned work
+                # item must not kill its worker (mod.rs workers are
+                # respawned per task; here the thread persists, so
+                # survive and count)
+                self._count_error(name, exc)
+            # captured INSIDE the work span: the deferred completion's
+            # resume span parents here, whatever thread resolves it
+            ctx = tracer.current()
         if _is_deferred(out):
             # verdict in flight: account at completion
             with self._lock:
-                self._deferred.append((name, len(items), out))
+                self._deferred.append((name, len(items), out, ctx))
             return
         with self._lock:
             self.processed[name] += len(items)
@@ -240,15 +285,17 @@ class BeaconProcessor:
                 return False
             if not block and not self._deferred[0][2].done():
                 return False
-            name, n, work = self._deferred.popleft()
-        try:
-            work.complete()
-        # lint: allow[broad-except] -- same worker survival boundary as
-        # _execute: completion runs arbitrary application callbacks
-        except Exception as exc:  # noqa: BLE001 -- a poisoned completion
-            # must not kill its worker; counted exactly like a handler
-            # failure
-            self._count_error(name, exc)
+            name, n, work, ctx = self._deferred.popleft()
+        tracer = self.tracer()
+        with tracer.attach(ctx), tracer.span(f"resume/{name}", n=n):
+            try:
+                work.complete()
+            # lint: allow[broad-except] -- same worker survival boundary
+            # as _execute: completion runs arbitrary application callbacks
+            except Exception as exc:  # noqa: BLE001 -- a poisoned
+                # completion must not kill its worker; counted exactly
+                # like a handler failure
+                self._count_error(name, exc)
         with self._lock:
             self.processed[name] += n
         return True

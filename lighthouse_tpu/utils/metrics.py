@@ -17,6 +17,25 @@ _DEFAULT_BUCKETS = (
 )
 
 
+def escape_help(text: str) -> str:
+    """Prometheus exposition-format HELP escaping: backslash and newline
+    only (a raw newline would split the HELP line and corrupt the whole
+    scrape)."""
+    return str(text).replace("\\", "\\\\").replace("\n", "\\n")
+
+
+def escape_label_value(value: str) -> str:
+    """Exposition-format label-value escaping: backslash, double-quote,
+    newline (an endpoint URL containing `"` must not terminate the label
+    early)."""
+    return (
+        str(value)
+        .replace("\\", "\\\\")
+        .replace('"', '\\"')
+        .replace("\n", "\\n")
+    )
+
+
 class Counter:
     def __init__(self, name: str, help_: str):
         self.name = name
@@ -30,24 +49,41 @@ class Counter:
 
     def expose(self) -> list[str]:
         return [
-            f"# HELP {self.name} {self.help}",
+            f"# HELP {self.name} {escape_help(self.help)}",
             f"# TYPE {self.name} counter",
             f"{self.name} {self.value:g}",
         ]
 
 
 class Gauge:
+    """Thread-safe like Counter (a queue-depth gauge is written from
+    every worker); `inc`/`dec` spare call sites the read-modify-write."""
+
     def __init__(self, name: str, help_: str):
         self.name = name
         self.help = help_
         self.value = 0.0
+        self._lock = threading.Lock()
 
     def set(self, value: float) -> None:
-        self.value = value
+        with self._lock:
+            self.value = value
+
+    def inc(self, amount: float = 1.0) -> None:
+        with self._lock:
+            self.value += amount
+
+    def dec(self, amount: float = 1.0) -> None:
+        with self._lock:
+            self.value -= amount
+
+    def get(self) -> float:
+        with self._lock:
+            return self.value
 
     def expose(self) -> list[str]:
         return [
-            f"# HELP {self.name} {self.help}",
+            f"# HELP {self.name} {escape_help(self.help)}",
             f"# TYPE {self.name} gauge",
             f"{self.name} {self.value:g}",
         ]
@@ -84,7 +120,7 @@ class Histogram:
 
     def expose(self) -> list[str]:
         out = [
-            f"# HELP {self.name} {self.help}",
+            f"# HELP {self.name} {escape_help(self.help)}",
             f"# TYPE {self.name} histogram",
         ]
         cum = 0
@@ -114,17 +150,21 @@ class LabeledGauge:
             self._children[str(label_value)] = value
 
     def get(self, label_value: str) -> float | None:
-        return self._children.get(str(label_value))
+        with self._lock:
+            return self._children.get(str(label_value))
 
     def expose(self) -> list[str]:
         out = [
-            f"# HELP {self.name} {self.help}",
+            f"# HELP {self.name} {escape_help(self.help)}",
             f"# TYPE {self.name} gauge",
         ]
         with self._lock:
             items = sorted(self._children.items())
         for lv, v in items:
-            out.append(f'{self.name}{{{self.label}="{lv}"}} {v:g}')
+            out.append(
+                f'{self.name}{{{self.label}="{escape_label_value(lv)}"}}'
+                f" {v:g}"
+            )
         return out
 
 
@@ -138,6 +178,13 @@ class Registry:
             m = self._metrics.get(name)
             if m is None:
                 m = self._metrics[name] = cls(name, help_, **kw)
+            elif not isinstance(m, cls):
+                # registry hygiene: one name, one family type -- silently
+                # handing a Counter to a gauge() caller corrupts both
+                raise ValueError(
+                    f"metric {name!r} already registered as "
+                    f"{type(m).__name__}, not {cls.__name__}"
+                )
             return m
 
     def counter(self, name: str, help_: str = "") -> Counter:
@@ -300,4 +347,91 @@ STORE_FSCK_RUNS = REGISTRY.counter(
 )
 STORE_FSCK_FAILURES = REGISTRY.counter(
     "store_fsck_issues_total", "Consistency violations found by db fsck"
+)
+
+# -- slot-relative delay family (reference beacon_block_delay_* in
+# beacon_chain/src/metrics.rs): seconds past the block's SLOT START on the
+# injected slot clock at each hot-path milestone. Replayable: the clock is
+# the chain's slot_clock, never the wall clock (lint rule span-wallclock).
+
+_SLOT_DELAY_BUCKETS = (
+    0.05, 0.1, 0.25, 0.5, 1.0, 1.5, 2.0, 3.0, 4.0, 6.0, 8.0, 12.0,
+)
+
+BLOCK_OBSERVED_DELAY = REGISTRY.histogram(
+    "beacon_block_observed_delay_seconds",
+    "Slot-start to gossip arrival of the block",
+    buckets=_SLOT_DELAY_BUCKETS,
+)
+BLOCK_VERIFIED_DELAY = REGISTRY.histogram(
+    "beacon_block_verified_delay_seconds",
+    "Slot-start to full signature verification of the block",
+    buckets=_SLOT_DELAY_BUCKETS,
+)
+BLOCK_IMPORTED_DELAY = REGISTRY.histogram(
+    "beacon_block_imported_delay_seconds",
+    "Slot-start to completed import (store + fork choice) of the block",
+    buckets=_SLOT_DELAY_BUCKETS,
+)
+BLOCK_HEAD_DELAY = REGISTRY.histogram(
+    "beacon_block_head_delay_seconds",
+    "Slot-start to the block becoming the canonical head",
+    buckets=_SLOT_DELAY_BUCKETS,
+)
+
+
+def slot_delay_seconds(slot_clock, slot: int) -> float:
+    """Seconds past `slot`'s start on the INJECTED slot clock (negative
+    when observed early, e.g. a locally-produced block)."""
+    start = slot_clock.genesis_time + slot * slot_clock.seconds_per_slot
+    return slot_clock.now() - start
+
+
+def observe_slot_delay(histogram: Histogram, slot_clock, slot: int) -> None:
+    """Record one slot-relative delay sample; the single seat the
+    span-wallclock lint rule audits for wall-clock operands."""
+    histogram.observe(slot_delay_seconds(slot_clock, slot))
+
+
+# -- beacon-processor scheduling family (beacon_processor.py) ----------------
+
+PROCESSOR_PENDING = REGISTRY.gauge(
+    "beacon_processor_work_pending",
+    "Work items queued across all processor lanes, not yet claimed",
+)
+PROCESSOR_QUEUE_WAIT = REGISTRY.histogram(
+    "beacon_processor_queue_wait_seconds",
+    "Enqueue-to-claim wait of the oldest item in each claimed batch "
+    "(tracer clock)",
+)
+
+# -- TPU device telemetry (crypto/bls/backends/jax_tpu.py marshal/dispatch
+# seam + parallel/verify_sharded.py mesh) ------------------------------------
+
+TPU_COMPILE_CACHE_HITS = REGISTRY.counter(
+    "tpu_compile_cache_hits_total",
+    "Batches whose bucketed (sets, pubkeys, messages) shape was already "
+    "compiled this process",
+)
+TPU_COMPILE_CACHE_MISSES = REGISTRY.counter(
+    "tpu_compile_cache_misses_total",
+    "Batches marshalled to a NEW bucketed shape (XLA compile expected)",
+)
+TPU_TRANSFER_BYTES = REGISTRY.counter(
+    "tpu_transfer_bytes_total",
+    "Host-to-device bytes marshalled for verification batches",
+)
+TPU_MARSHAL_BATCH_BYTES = REGISTRY.gauge(
+    "tpu_marshal_batch_bytes",
+    "Host-to-device bytes of the most recent marshalled batch",
+)
+TPU_PUBKEY_TABLE_BYTES = REGISTRY.gauge(
+    "tpu_pubkey_table_bytes",
+    "Device-resident decompressed pubkey table size in bytes",
+)
+MESH_CHIP_BATCH_SECONDS = REGISTRY.labeled_gauge(
+    "bls_mesh_chip_last_batch_seconds",
+    "Per-chip wall of the last sharded batch this chip participated in "
+    "(tracer clock)",
+    label="chip",
 )

@@ -90,6 +90,71 @@ class TestEndpoints:
             text = r.read().decode()
         assert "event: head" in text and "event: block" in text
 
+    def test_events_stream_sse_framing(self, rig):
+        """Strict SSE coverage: text/event-stream content type, every
+        frame is `event:` + `data:` + blank separator, every data line
+        is valid JSON, and block events carry slot + 0x-hex root in
+        chain order."""
+        h, node, server, client = rig
+        h.extend_chain(3)
+        import json as _json
+        import urllib.request
+
+        with urllib.request.urlopen(
+            f"http://127.0.0.1:{server.port}/eth/v1/events"
+        ) as r:
+            ctype = r.headers.get("Content-Type")
+            text = r.read().decode()
+        assert ctype == "text/event-stream"
+        frames = [f for f in text.split("\n\n") if f]
+        events = []
+        for frame in frames:
+            lines = frame.split("\n")
+            assert lines[0].startswith("event: "), frame
+            assert lines[1].startswith("data: "), frame
+            assert len(lines) == 2, frame
+            payload = _json.loads(lines[1][len("data: "):])
+            events.append((lines[0][len("event: "):], payload))
+        kinds = [k for k, _ in events]
+        assert kinds.count("block") == 3
+        assert "head" in kinds
+        block_slots = [p["slot"] for k, p in events if k == "block"]
+        assert block_slots == sorted(block_slots)
+        for k, p in events:
+            if k in ("block", "head"):
+                assert p["block"].startswith("0x")
+                assert len(p["block"]) == 66
+        # every import that moved the head produced a head event
+        assert kinds.count("head") == 3
+
+    def test_tracing_status_and_dump_routes(self, rig):
+        """/lighthouse/tracing/{status,dump}: status reports the ring,
+        dump serves Chrome trace-event JSON with the import spans a
+        chain extension just produced."""
+        h, node, server, client = rig
+        h.extend_chain(2)
+        import json as _json
+        import urllib.request
+
+        base = f"http://127.0.0.1:{server.port}"
+        with urllib.request.urlopen(f"{base}/lighthouse/tracing/status") as r:
+            status = _json.loads(r.read())["data"]
+        assert status["enabled"] is True
+        assert status["recorded"] >= 1
+        assert status["capacity"] >= status["recorded"]
+        with urllib.request.urlopen(f"{base}/lighthouse/tracing/dump") as r:
+            assert r.headers.get("Content-Type") == "application/json"
+            trace = _json.loads(r.read())
+        events = trace["traceEvents"]
+        assert events, "no trace events recorded"
+        names = {e["name"] for e in events}
+        assert "block_import" in names
+        for e in events:
+            assert e["ph"] == "X"
+            assert isinstance(e["ts"], (int, float))
+            assert isinstance(e["dur"], (int, float))
+            assert "tid" in e and "pid" in e
+
 
 class TestVcOverHttp:
     def test_validator_client_drives_chain_through_http(self, rig):

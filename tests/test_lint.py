@@ -922,6 +922,79 @@ def test_suppression_only_silences_named_rule(tmp_path):
     assert "float-consensus" in rules_hit(vs)
 
 
+# --- span-wallclock ---------------------------------------------------------
+
+
+def test_span_wallclock_positive_wall_read_in_tracing_module(tmp_path):
+    """A tracing module must never read the wall clock itself -- even
+    monotonic/perf_counter, which the plain wallclock rule allows
+    outside consensus code."""
+    vs = lint_fixture(
+        tmp_path, "utils/tracing.py",
+        """
+        import time
+        class Tracer:
+            def start_span(self, name):
+                return time.perf_counter()
+        """,
+    )
+    assert "span-wallclock" in rules_hit(vs)
+
+
+def test_span_wallclock_positive_wall_read_in_span_args(tmp_path):
+    vs = lint_fixture(
+        tmp_path, "network/thing.py",
+        """
+        import time
+        def f(tracer):
+            tracer.instant("gossip_rx", at=time.monotonic())
+        """,
+    )
+    assert "span-wallclock" in rules_hit(vs)
+
+
+def test_span_wallclock_positive_delay_metric_from_wallclock(tmp_path):
+    vs = lint_fixture(
+        tmp_path, "utils/thing.py",
+        """
+        from time import time as _now
+        def f(hist, clock, slot):
+            observe_slot_delay(hist, make_clock(_now()), slot)
+        """,
+    )
+    assert "span-wallclock" in rules_hit(vs)
+
+
+def test_span_wallclock_negative_injected_clock(tmp_path):
+    vs = lint_fixture(
+        tmp_path, "utils/tracing.py",
+        """
+        class Tracer:
+            def __init__(self, clock):
+                self.clock = clock
+            def start_span(self, name):
+                return self.clock.now()
+        def span_user(tracer, clock):
+            tracer.span("work", at=clock.now())
+        """,
+    )
+    assert "span-wallclock" not in rules_hit(vs)
+
+
+def test_span_wallclock_negative_wall_read_outside_span_call(tmp_path):
+    """perf_counter elsewhere (e.g. a histogram timer) stays legal: only
+    tracing modules and span/delay-call arguments are in scope."""
+    vs = lint_fixture(
+        tmp_path, "utils/metrics_like.py",
+        """
+        import time
+        def timer():
+            return time.perf_counter()
+        """,
+    )
+    assert "span-wallclock" not in rules_hit(vs)
+
+
 # --- baseline ratchet -------------------------------------------------------
 
 
@@ -958,7 +1031,7 @@ def test_baseline_empty_means_any_violation_is_new():
 
 def test_rule_catalogue_complete():
     """Every rule has an id, a docstring, and appears in the registry."""
-    assert len(ALL_RULES) == 12
+    assert len(ALL_RULES) == 13
     for rule in ALL_RULES:
         assert rule.id and rule.id == rule.id.lower()
         assert rule.__doc__ and rule.id in rule.__doc__.split(":")[0]

@@ -54,6 +54,236 @@ class TestRegistry:
         assert reg.counter("x_total") is reg.counter("x_total")
 
 
+class TestExpositionFormat:
+    """Golden-format coverage: the exposition must parse under a STRICT
+    line checker (the Prometheus text format), label values and HELP
+    must be escaped, and histograms must carry +Inf/_sum/_count."""
+
+    # one exposition line: HELP, TYPE, or a sample with optional label
+    _NAME = r"[a-zA-Z_:][a-zA-Z0-9_:]*"
+    _VALUE = r"[-+]?(?:[0-9]*\.?[0-9]+(?:[eE][-+]?[0-9]+)?|Inf|NaN)"
+    _LABEL = r'\{[a-zA-Z_][a-zA-Z0-9_]*="(?:[^"\\\n]|\\\\|\\"|\\n)*"\}'
+
+    def _check_lines(self, text: str):
+        import re
+
+        line_re = re.compile(
+            rf"^(?:# HELP {self._NAME} [^\n]*"
+            rf"|# TYPE {self._NAME} (?:counter|gauge|histogram)"
+            rf"|{self._NAME}(?:{self._LABEL})? {self._VALUE})$"
+        )
+        for line in text.splitlines():
+            if not line:
+                continue
+            assert line_re.match(line), f"malformed exposition line: {line!r}"
+
+    def test_registry_exposition_is_strictly_parseable(self):
+        reg = Registry()
+        c = reg.counter("fmt_total", "counter help")
+        c.inc(2)
+        g = reg.gauge("fmt_gauge", "gauge help")
+        g.set(-1.5)
+        h = reg.histogram("fmt_seconds", "hist help", buckets=(0.1, 1.0))
+        h.observe(0.05)
+        h.observe(12.0)
+        lg = reg.labeled_gauge("fmt_labeled", "labeled help", label="endpoint")
+        lg.set("http://x:8545", 0.25)
+        text = reg.expose()
+        self._check_lines(text)
+        assert 'fmt_seconds_bucket{le="+Inf"} 2' in text
+        assert "fmt_seconds_sum 12.05" in text
+        assert "fmt_seconds_count 2" in text
+
+    def test_global_registry_exposition_is_strictly_parseable(self):
+        self._check_lines(REGISTRY.expose())
+
+    def test_label_value_escaping(self):
+        reg = Registry()
+        lg = reg.labeled_gauge("esc_gauge", "h", label="endpoint")
+        lg.set('http://u:p@host/"quoted"\\path\nnext', 1.0)
+        text = reg.expose()
+        self._check_lines(text)
+        # escaped per the exposition format: \\ then \" then \n
+        assert (
+            'esc_gauge{endpoint="http://u:p@host/\\"quoted\\"\\\\path\\nnext"}'
+            " 1" in text
+        )
+        # and get() round-trips the RAW value (lock held)
+        assert lg.get('http://u:p@host/"quoted"\\path\nnext') == 1.0
+
+    def test_help_escaping(self):
+        reg = Registry()
+        reg.counter("esc_total", "line one\nline two \\ backslash")
+        text = reg.expose()
+        self._check_lines(text)
+        assert "# HELP esc_total line one\\nline two \\\\ backslash" in text
+
+    def test_gauge_inc_dec_and_thread_safety(self):
+        import threading
+
+        g = Registry().gauge("depth_gauge", "h")
+        g.inc()
+        g.inc(4)
+        g.dec(2)
+        assert g.get() == 3
+
+        def worker():
+            for _ in range(2000):
+                g.inc()
+                g.dec()
+
+        threads = [threading.Thread(target=worker) for _ in range(4)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        assert g.get() == 3
+
+
+class TestRegistryHygiene:
+    """Registry hygiene: unique well-formed names, non-empty HELP, no
+    ad-hoc metric families bypassing the registry, no type collisions."""
+
+    def test_all_registered_metrics_have_valid_names_and_help(self):
+        import re
+
+        name_re = re.compile(r"^[a-zA-Z_:][a-zA-Z0-9_:]*$")
+        seen = set()
+        for name, m in REGISTRY._metrics.items():
+            assert name_re.match(name), f"bad metric name {name!r}"
+            assert name == m.name
+            assert name not in seen
+            seen.add(name)
+            assert m.help and m.help.strip(), f"{name} has empty HELP"
+
+    def test_module_level_families_are_registered(self):
+        from lighthouse_tpu.utils import metrics as mod
+        from lighthouse_tpu.utils.metrics import (
+            Counter,
+            Gauge,
+            Histogram,
+            LabeledGauge,
+        )
+
+        for attr in dir(mod):
+            m = getattr(mod, attr)
+            if isinstance(m, (Counter, Gauge, Histogram, LabeledGauge)):
+                assert REGISTRY._metrics.get(m.name) is m, (
+                    f"metrics.{attr} ({m.name}) is not in REGISTRY"
+                )
+
+    def test_no_adhoc_families_outside_metrics_module(self):
+        """Every Counter/Gauge/Histogram/LabeledGauge in lighthouse_tpu
+        is constructed through a Registry (utils/metrics.py owns the
+        classes): an ad-hoc instance would expose nowhere."""
+        import ast
+        from pathlib import Path
+
+        pkg = Path(__file__).resolve().parents[1] / "lighthouse_tpu"
+        classes = {"Counter", "Gauge", "Histogram", "LabeledGauge"}
+        offenders = []
+        for path in pkg.rglob("*.py"):
+            if path.name == "metrics.py":
+                continue
+            tree = ast.parse(path.read_text(encoding="utf-8"))
+            for node in ast.walk(tree):
+                if (
+                    isinstance(node, ast.Call)
+                    and isinstance(node.func, ast.Name)
+                    and node.func.id in classes
+                ):
+                    offenders.append(f"{path.name}:{node.lineno}")
+        assert not offenders, f"ad-hoc metric construction: {offenders}"
+
+    def test_type_collision_raises(self):
+        reg = Registry()
+        reg.counter("collide_total", "h")
+        with pytest.raises(ValueError, match="already registered"):
+            reg.gauge("collide_total", "h")
+
+
+class TestSlotDelayAndDeviceTelemetry:
+    """The PR-5 observability families: slot-relative block delays and
+    TPU device telemetry are registered, exposed, and populated by a
+    chain run."""
+
+    def test_delay_and_telemetry_families_exposed(self):
+        text = REGISTRY.expose()
+        for name in (
+            "beacon_block_observed_delay_seconds",
+            "beacon_block_verified_delay_seconds",
+            "beacon_block_imported_delay_seconds",
+            "beacon_block_head_delay_seconds",
+            "beacon_processor_work_pending",
+            "beacon_processor_queue_wait_seconds",
+            "tpu_compile_cache_hits_total",
+            "tpu_compile_cache_misses_total",
+            "tpu_transfer_bytes_total",
+            "tpu_marshal_batch_bytes",
+            "tpu_pubkey_table_bytes",
+            "bls_mesh_chip_last_batch_seconds",
+        ):
+            assert name in text, f"{name} missing from exposition"
+
+    def test_block_import_populates_slot_delays(self):
+        from lighthouse_tpu.utils.metrics import (
+            BLOCK_HEAD_DELAY,
+            BLOCK_IMPORTED_DELAY,
+        )
+
+        imported = BLOCK_IMPORTED_DELAY.count
+        head = BLOCK_HEAD_DELAY.count
+        sum_before = BLOCK_IMPORTED_DELAY.sum
+        h = BeaconChainHarness(16, MINIMAL, ChainSpec.interop())
+        h.extend_chain(3)
+        assert BLOCK_IMPORTED_DELAY.count == imported + 3
+        assert BLOCK_HEAD_DELAY.count == head + 3
+        # ManualSlotClock pins now() to the slot start: each delay is
+        # exactly 0, proving the measurement rides the INJECTED clock
+        assert BLOCK_IMPORTED_DELAY.sum - sum_before == pytest.approx(0.0)
+
+    def test_slot_delay_helper_measures_against_slot_start(self):
+        from lighthouse_tpu.utils.metrics import slot_delay_seconds
+
+        class Clock:
+            genesis_time = 100
+            seconds_per_slot = 12
+
+            def now(self):
+                return 100 + 12 * 5 + 3.5  # 3.5 s into slot 5
+
+        assert slot_delay_seconds(Clock(), 5) == pytest.approx(3.5)
+        assert slot_delay_seconds(Clock(), 6) == pytest.approx(-8.5)
+
+    def test_marshal_records_transfer_and_compile_cache(self):
+        from lighthouse_tpu.crypto.bls import SecretKey, SignatureSet
+        from lighthouse_tpu.crypto.bls.backends import jax_tpu
+        from lighthouse_tpu.utils.metrics import (
+            TPU_COMPILE_CACHE_HITS,
+            TPU_COMPILE_CACHE_MISSES,
+            TPU_MARSHAL_BATCH_BYTES,
+            TPU_TRANSFER_BYTES,
+        )
+
+        sk = SecretKey(7)
+        msg = b"\x11" * 32
+        sets = [SignatureSet.single_pubkey(sk.sign(msg), sk.public_key(), msg)]
+        jax_tpu._seen_shape_buckets.clear()
+        misses, hits = (
+            TPU_COMPILE_CACHE_MISSES.value,
+            TPU_COMPILE_CACHE_HITS.value,
+        )
+        transferred = TPU_TRANSFER_BYTES.value
+        assert jax_tpu._marshal_batch(sets) is not None
+        assert TPU_COMPILE_CACHE_MISSES.value == misses + 1
+        assert TPU_TRANSFER_BYTES.value > transferred
+        assert TPU_MARSHAL_BATCH_BYTES.value > 0
+        # same bucketed shape again: a compile-cache hit
+        assert jax_tpu._marshal_batch(sets) is not None
+        assert TPU_COMPILE_CACHE_HITS.value == hits + 1
+        assert TPU_COMPILE_CACHE_MISSES.value == misses + 1
+
+
 class TestChainMetricsAndMonitor:
     def test_block_import_populates_phase_timers_and_monitor(self):
         before = REGISTRY._metrics["beacon_block_processing_seconds"].count

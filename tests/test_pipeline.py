@@ -208,8 +208,9 @@ class TestBeaconProcessor:
             bp.submit("gossip_attestation", i)
         assert len(q) == 4
         assert q.dropped == 2
-        # newest survive (LIFO sheds oldest)
-        assert sorted(q.items) == [2, 3, 4, 5]
+        # newest survive (LIFO sheds oldest); items ride with their
+        # enqueue stamp + clock (queue-wait metric)
+        assert sorted(it for it, *_ in q.items) == [2, 3, 4, 5]
 
 
 class TestBeaconProcessorWorkerPool:

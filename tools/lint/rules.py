@@ -763,6 +763,96 @@ class TracerLeakRule:
                         )
 
 
+class SpanWallclockRule:
+    """span-wallclock: spans and delay metrics ride the injected clock.
+
+    The tracing layer's determinism contract (utils/tracing.py): a
+    seeded replay under ``VirtualClock`` must export a bit-identical
+    trace, so trace timestamps and slot-delay samples may only come from
+    the injected clock/rng. Two shapes are flagged: ANY wall-clock read
+    (``time.time``/``time.monotonic``/``time.perf_counter``/
+    ``datetime.now``/``utcnow``) inside a tracing module (a file named
+    ``tracing.py`` -- the tracer must stay clock-agnostic; entry points
+    inject wall clocks at their own boundary), and a wall-clock read
+    appearing in the ARGUMENTS of a span/delay call (``span``,
+    ``start_span``, ``instant``, ``observe_slot_delay``,
+    ``slot_delay_seconds``) anywhere in the tree -- a span attribute
+    stamped from ``time.time()`` silently breaks replay even where
+    monotonic reads are otherwise legal.
+    """
+
+    id = "span-wallclock"
+
+    _SPAN_LEAVES = (
+        "span", "start_span", "instant",
+        "observe_slot_delay", "slot_delay_seconds",
+    )
+    _WALL_TAILS = ("time", "monotonic", "perf_counter")
+    _DT_TAILS = ("now", "utcnow", "today")
+
+    def _wall_read(self, node, time_names, time_froms, dt_froms) -> str | None:
+        """The dotted name of a wall-clock read, or None."""
+        if not isinstance(node, ast.Call):
+            return None
+        dotted = _dotted(node.func)
+        if not dotted:
+            return None
+        parts = dotted.split(".")
+        if len(parts) == 1:
+            orig = time_froms.get(parts[0])
+            if orig in self._WALL_TAILS:
+                return f"time.{orig}"
+            return None
+        head, tail = parts[-2], parts[-1]
+        if head in dt_froms:
+            head = dt_froms[head]
+        if (
+            head in time_names or head in ("time", "_time")
+        ) and tail in self._WALL_TAILS:
+            return dotted
+        if head in ("datetime", "date") and tail in self._DT_TAILS:
+            return dotted
+        return None
+
+    def check(self, ctx):
+        in_tracing = ctx.path.rsplit("/", 1)[-1] == "tracing.py"
+        time_names, time_froms = _import_bindings(ctx.tree, "time")
+        _dt_names, dt_froms = _import_bindings(ctx.tree, "datetime")
+        for node in ast.walk(ctx.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            if in_tracing:
+                read = self._wall_read(
+                    node, time_names, time_froms, dt_froms
+                )
+                if read:
+                    yield ctx.violation(
+                        self.id, node,
+                        f"wall-clock read ({read}) inside a tracing "
+                        "module; the tracer must use its injected clock "
+                        "(replay contract)",
+                    )
+                    continue
+            leaf = (_dotted(node.func) or "").split(".")[-1]
+            if leaf not in self._SPAN_LEAVES:
+                continue
+            operands = list(node.args) + [
+                kw.value for kw in node.keywords
+            ]
+            for arg in operands:
+                for sub in ast.walk(arg):
+                    read = self._wall_read(
+                        sub, time_names, time_froms, dt_froms
+                    )
+                    if read:
+                        yield ctx.violation(
+                            self.id, sub,
+                            f"wall-clock read ({read}) feeds a "
+                            f"{leaf}() span/delay call; pass the "
+                            "injected clock's value instead",
+                        )
+
+
 class BareAtomicBatchRule:
     """bare-atomic-batch: multi-key CHAIN-column mutations must commit as
     one atomic batch.
@@ -840,6 +930,7 @@ ALL_RULES = [
     RetryNoBackoffRule(),
     MutableDefaultRule(),
     TracerLeakRule(),
+    SpanWallclockRule(),
     BareAtomicBatchRule(),
 ]
 

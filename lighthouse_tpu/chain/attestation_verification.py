@@ -20,6 +20,14 @@ Two upgrades over the reference's batch.rs:
     backend calls for k bad items instead of the reference's O(n)
     per-item fallback (batch.rs:122-133) -- keeping the no-censorship
     guarantee: every valid item in a poisoned batch is still accepted.
+
+The bisection is also the failure-attribution half of the MEGA-PAIRING
+(crypto/bls/aggregation.py): the aggregated path collapses a whole
+slot's attestations into ~distinct-messages Miller pairs, so a reject
+names only the batch -- every sub-batch the bisection re-verifies runs
+through the same aggregated backend, and the O(k log n) search pins the
+k forged items exactly as it does on the per-set path
+(tests/test_bls_aggregation.py plants forgeries and asserts this).
 """
 
 from __future__ import annotations
@@ -90,7 +98,9 @@ def bisect_batch_failures(items, sets_of, verify=None):
     backend call), then one call certifies the remaining tail clean or
     restarts the search inside it. One bad item in a 1024-item batch
     costs ceil(log2 1024) + 1 = 11 extra calls. Returns
-    (ok_items, bad_items); every call bumps BLS_BISECTION_CALLS.
+    (ok_items, bad_items); every call bumps BLS_BISECTION_CALLS and every
+    isolated item BLS_BISECTION_BAD_ITEMS (the attribution rate of the
+    mega-pairing's all-or-nothing verdict).
     """
     verify = verify or verify_signature_sets
 
@@ -120,6 +130,7 @@ def bisect_batch_failures(items, sets_of, verify=None):
         if group and check(group):
             ok.extend(group)
             break
+    M.BLS_BISECTION_BAD_ITEMS.inc(len(bad))
     return ok, bad
 
 

@@ -215,6 +215,13 @@ def build_beacon_node(args):
 
         # held (via the args reference) for the life of the node
         args._datadir_lock = _lock_datadir(args.datadir)
+        # persistent XLA compile cache under the datadir: the 70-360s
+        # per-shape verifier compile is paid once per binary, not once
+        # per process (utils/compile_cache.py; disk-warm shapes surface
+        # as tpu_compile_cache_hits_total on restart)
+        from .utils.compile_cache import arm as _arm_compile_cache
+
+        _arm_compile_cache(os.path.join(args.datadir, "compile_cache"))
         native_path = os.path.join(args.datadir, "chain.db")
         if os.path.isdir(args.datadir) and not os.path.exists(
             native_path

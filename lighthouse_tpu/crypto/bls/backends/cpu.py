@@ -4,6 +4,14 @@ reference crypto/bls/src/impls/milagro.rs).
 Same random-linear-combination batch semantics as the TPU backend, executed
 with the oracle pairing: one multi-Miller-loop product and one final
 exponentiation for the whole batch (reference impls/blst.rs:36-119).
+
+Message-aggregated like the TPU path (crypto/bls/aggregation.py derives
+the identity): after each set's own random weight is applied, the
+weighted aggregate pubkeys of sets sharing a message collapse into ONE
+G1 point, so the oracle pays m + 1 Miller loops for m distinct messages
+instead of n + 1 for n sets -- the fallback keeps the mega-pairing's
+cost shape AND its accept/reject semantics, which is what makes it a
+drop-in degradation target for the jax_tpu aggregated path.
 """
 
 from __future__ import annotations
@@ -33,16 +41,27 @@ def _set_checks(s) -> C.Point | None:
 
 def verify_signature_sets(sets, seed=None) -> bool:
     rng = random.Random(seed)
-    pairs = []
+    group_pk: dict[bytes, C.Point] = {}
+    order: list[bytes] = []
     sig_acc = None
     for s in sets:
         agg_pk = _set_checks(s)
         if agg_pk is None:
             return False
         r = rng.getrandbits(64) | 1  # nonzero weight (blst.rs:45-57)
-        pairs.append((agg_pk.mul(r), hash_to_g2(s.message)))
+        # per-set weight FIRST, then per-message grouping: the weight is
+        # drawn after the adversary commits to the set, so a forged set
+        # cannot cancel an honest one inside its message group
+        weighted_pk = agg_pk.mul(r)
+        msg = bytes(s.message)
+        if msg in group_pk:
+            group_pk[msg] = group_pk[msg] + weighted_pk
+        else:
+            group_pk[msg] = weighted_pk
+            order.append(msg)
         weighted = s.signature.point.mul(r)
         sig_acc = weighted if sig_acc is None else sig_acc + weighted
+    pairs = [(group_pk[m], hash_to_g2(m)) for m in order]
     pairs.append((-C.g1_generator(), sig_acc))
     return PR.multi_pairing(pairs) == PR.Fp12.one()
 

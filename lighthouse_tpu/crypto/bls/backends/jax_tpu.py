@@ -25,13 +25,15 @@ indices + messages + signatures only.
 from __future__ import annotations
 
 import os
+from dataclasses import dataclass
 
 import numpy as np
 
 import jax
 import jax.numpy as jnp
 
-from ....utils import metrics, tracing
+from ....utils import compile_cache, metrics, tracing
+from .. import aggregation as AG
 from ..tpu import curve as TC
 from ..tpu import hash_to_curve as THC
 from ..tpu import limbs as L
@@ -261,6 +263,59 @@ def verify_device(u, h_idx, pk_jac, sig_jac, scalars, real):
     return _stage_final(fprod, flags_ok)
 
 
+# --- message-aggregated (mega-pairing) stages -------------------------------
+#
+# The staged pipeline above pays one Miller pair PER SET (+1 generator
+# pair). Mainnet traffic is thousands of sets over a handful of distinct
+# messages, and the RLC check is bilinear in the G1 side, so the weighted
+# per-set pubkeys of every set sharing a message collapse into ONE point
+# (crypto/bls/aggregation.py derives the identity). The aggregated path
+# REUSES _stage_prep verbatim (weights, subgroup checks, signature sum --
+# its executable is already warm from the per-set path; per-shape compile
+# cost is the scarce resource here) and inserts one small new program, the
+# per-message group reduction, BEFORE pair assembly; _stage_miller /
+# _stage_final then run at m_b + 1 pairs instead of n_b + 1 -- pairing
+# cost scales with distinct messages, not sets -- and are shared verbatim
+# with the per-set and aggregate_verify paths, so a warm (m_b + 1)-pair
+# executable serves all three.
+
+
+@jax.jit
+def _stage_group(rpk_aff, rpk_inf, grid_idx, grid_real):
+    """Per-message pubkey aggregation: gather the weighted per-set G1
+    points into the (m_b, g_b) group grid (padding slots masked to
+    infinity), lift to projective, and sum each message's row -- ONE
+    scanned halving body over the group axis, batched over messages.
+    Returns affine points + inf mask sized for the m_b-pair Miller
+    stage."""
+    rows_aff = jnp.take(rpk_aff, grid_idx, axis=0)  # (m_b, g_b, 2, W)
+    rows_inf = jnp.take(rpk_inf, grid_idx, axis=0) | ~grid_real
+    rows = TC.from_affine(rows_aff, rows_inf, TC.FP)
+    gpk = TC.sum_points(jnp.moveaxis(rows, 1, 0), TC.FP)  # (m_b, 3, W)
+    return TC.to_affine_g1(gpk)
+
+
+def verify_device_aggregated(
+    u, pk_jac, sig_jac, scalars, real, grid_idx, grid_real
+):
+    """The message-aggregated batch verify: the SAME per-set prep as
+    `verify_device`, then a per-message group reduction, then ONE
+    multi-pairing over m_b + 1 pairs (m_b = bucketed distinct messages).
+    Accept/reject is algebraically identical to `verify_device` for the
+    same weights -- the grouped product IS the per-set product by
+    bilinearity -- so the CPU-oracle parity contract carries over
+    unchanged (tests/test_bls_aggregation.py)."""
+    h_aff, h_inf = _stage_hash(u)
+    rpk_aff, rpk_inf, ssum_aff, ssum_inf, flags_ok = _stage_prep(
+        pk_jac, sig_jac, scalars, real
+    )
+    gpk_aff, gpk_inf = _stage_group(rpk_aff, rpk_inf, grid_idx, grid_real)
+    fprod = _stage_miller(
+        gpk_aff, gpk_inf, h_aff, h_inf, ssum_aff, ssum_inf
+    )
+    return _stage_final(fprod, flags_ok)
+
+
 def _bucket(n: int, floor: int = 4) -> int:
     """Next power-of-two shape bucket with a floor of 4: small batches all
     share ONE compiled kernel shape (the reference's warm-shape concern;
@@ -293,16 +348,29 @@ def _common_table(sets):
 _seen_shape_buckets: set[tuple] = set()
 
 
-def _count_shape_bucket(n_b: int, k_b: int, m_b: int) -> None:
-    # keyed on the bucketed DEVICE-ARG shapes only: the gather and
-    # host-packed paths feed identically-shaped args to the same jit
-    # executables, so switching paths at a warm shape is a cache HIT
-    key = (n_b, k_b, m_b)
+def _count_shape_bucket(n_b: int, k_b: int, m_b: int, g_b: int = 0):
+    """Count this batch's bucketed shape against the in-process and
+    persistent compile caches. Keyed on the bucketed DEVICE-ARG shapes
+    only: the gather and host-packed paths feed identically-shaped args
+    to the same jit executables, so switching paths at a warm shape is a
+    cache HIT (g_b = 0 marks the per-set path; nonzero the aggregated
+    grid). Returns the shape key when an XLA compile is expected (cold
+    in-process AND on disk) so the dispatcher can register it with the
+    persistent registry AFTER the compile actually completes -- a
+    process killed mid-compile must not leave a phantom 'warm' entry."""
+    key = (n_b, k_b, m_b, g_b)
     if key in _seen_shape_buckets:
         metrics.TPU_COMPILE_CACHE_HITS.inc()
-    else:
-        _seen_shape_buckets.add(key)
-        metrics.TPU_COMPILE_CACHE_MISSES.inc()
+        return None
+    _seen_shape_buckets.add(key)
+    if compile_cache.shape_on_disk(key):
+        # process-cold but DISK-warm: the persistent compilation cache
+        # (utils/compile_cache.py, armed under the datadir) serves the
+        # executables, so no XLA compile happens
+        metrics.TPU_COMPILE_CACHE_HITS.inc()
+        return None
+    metrics.TPU_COMPILE_CACHE_MISSES.inc()
+    return key
 
 
 def _count_transfer(*arrays) -> None:
@@ -313,12 +381,50 @@ def _count_transfer(*arrays) -> None:
     metrics.TPU_MARSHAL_BATCH_BYTES.set(total)
 
 
-def _marshal_batch(sets, seed=None):
+@dataclass
+class Marshalled:
+    """One marshalled batch: the device args of every dispatch path plus
+    the aggregation grid (None on the per-set path) and host-side batch
+    facts the dispatcher's metrics need."""
+
+    u: object
+    h_idx: object
+    pk: object
+    sig: object
+    scalars: object
+    real: object
+    grid_idx: object  # (m_b, g_b) int32 device array, or None
+    grid_real: object  # (m_b, g_b) bool device array, or None
+    n_sets: int
+    n_messages: int
+    # shape key to register as compiled once dispatch returns (None when
+    # the shape was already warm in-process or on disk)
+    new_shape_key: tuple | None = None
+
+
+def _msg_agg_enabled() -> bool:
+    """Message aggregation (the mega-pairing) is ON unless explicitly
+    disabled; read per call so benches/tests flip it without reimport."""
+    return os.environ.get("LIGHTHOUSE_TPU_MSG_AGG", "1") != "0"
+
+
+def _mesh_eligible(n_b: int) -> bool:
+    """Mirrors the dispatch routing: bucketed batches at/above the shard
+    threshold go to the device mesh (per-set layout), so marshalling
+    skips the aggregation grid for them."""
+    threshold = _shard_min_sets()
+    return bool(threshold) and n_b >= threshold and len(jax.devices()) > 1
+
+
+def _marshal_batch(sets, seed=None, groups=None):
     """Host-side marshalling for one batch: shape bucketing, distinct-
-    message dedup, limb packing (or device-table index gather), weights.
-    Returns the 6-tuple of `verify_device` arguments, or None when a
-    structural check already decides the batch (empty pubkeys / infinity
-    signature -> invalid, no device work)."""
+    message grouping, limb packing (or device-table index gather),
+    weights, and -- when the batch repeats messages -- the per-message
+    aggregation grid for the mega-pairing path. Returns a `Marshalled`,
+    or None when a structural check already decides the batch (empty
+    pubkeys / infinity signature -> invalid, no device work). `groups`
+    is an optional precomputed `aggregation.MessageGroups` (the pipeline
+    computes it pre-marshal on the submit thread)."""
     # host-side structural checks (cheap; device work is all-or-nothing)
     for s in sets:
         if not s.pubkeys or s.signature.point.inf:
@@ -329,18 +435,20 @@ def _marshal_batch(sets, seed=None):
     n_b = _bucket(n)
     k_b = _bucket(k)
 
-    # Distinct-message dedup: map each set to a row of the unique-message
-    # draw tensor (hash-to-curve cost scales with distinct messages; see
-    # verify_device). Padded sets point at row 0 -- their pairing
-    # contribution is masked by weight 0 regardless.
-    uniq: dict[bytes, int] = {}
+    # Distinct-message grouping: maps each set to a row of the unique-
+    # message draw tensor (hash-to-curve cost scales with distinct
+    # messages; see verify_device) and names each message's member sets
+    # (the aggregated path's group reduction). Padded sets point at row
+    # 0 -- their pairing contribution is masked by weight 0 regardless.
+    if groups is None:
+        with tracing.span("bls_aggregate", sets=n):
+            groups = AG.group_sets(sets)
+    m = groups.n_messages
     h_idx = np.zeros((n_b,), np.int32)
-    for i, s in enumerate(sets):
-        msg = bytes(s.message)
-        h_idx[i] = uniq.setdefault(msg, len(uniq))
-    m_b = _bucket(len(uniq))
+    h_idx[:n] = groups.set_message
+    m_b = _bucket(m)
     u = np.zeros((m_b, 2, 2, W), np.int32)
-    for msg, j in uniq.items():
+    for j, msg in enumerate(groups.messages):
         u[j] = _field_draws_cached(msg)
 
     sig = np.zeros((n_b, 3, 2, W), np.int32)
@@ -348,8 +456,20 @@ def _marshal_batch(sets, seed=None):
     for i, s in enumerate(sets):
         sig[i] = _sig_limbs(s.signature)
 
+    # Aggregation grid: only when grouping actually collapses BUCKETED
+    # pairs (m_b < n_b -- the Miller stage runs at bucketed shapes, so
+    # m < n inside the same power-of-two bucket would pay the group
+    # reduction and a fresh compile shape for zero pair savings) and the
+    # batch stays on the single-chip staged path -- the mesh shards the
+    # per-set axis and keeps the per-set layout.
+    grid_idx = grid_real = None
+    g_b = 0
+    if _msg_agg_enabled() and m_b < n_b and not _mesh_eligible(n_b):
+        g_b = _bucket(groups.max_group())
+        grid_idx, grid_real = AG.group_grid(groups.members, m_b, g_b)
+
     table = _common_table(sets)
-    _count_shape_bucket(n_b, k_b, m_b)
+    new_shape_key = _count_shape_bucket(n_b, k_b, m_b, g_b)
     if table is not None:
         # Steady-state marshaling (validator_pubkey_cache.rs:10-23):
         # host->device traffic is validator INDICES; limb rows are gathered
@@ -385,15 +505,21 @@ def _marshal_batch(sets, seed=None):
 
     real = np.zeros((n_b,), bool)
     real[:n] = True
-    _count_transfer(u, h_idx, sig, scalars, real, *pk_traffic)
+    grid_traffic = () if grid_idx is None else (grid_idx, grid_real)
+    _count_transfer(u, h_idx, sig, scalars, real, *grid_traffic, *pk_traffic)
 
-    return (
-        jnp.asarray(u),
-        jnp.asarray(h_idx),
-        pk_dev,
-        jnp.asarray(sig),
-        jnp.asarray(scalars),
-        jnp.asarray(real),
+    return Marshalled(
+        u=jnp.asarray(u),
+        h_idx=jnp.asarray(h_idx),
+        pk=pk_dev,
+        sig=jnp.asarray(sig),
+        scalars=jnp.asarray(scalars),
+        real=jnp.asarray(real),
+        grid_idx=None if grid_idx is None else jnp.asarray(grid_idx),
+        grid_real=None if grid_real is None else jnp.asarray(grid_real),
+        n_sets=n,
+        n_messages=m,
+        new_shape_key=new_shape_key,
     )
 
 
@@ -419,37 +545,70 @@ def _mesh_verifier():
 _MESH = None
 
 
-def dispatch_verify_signature_sets(sets, seed=None):
+def _count_pairs(n_sets: int, pairs: int, aggregated: bool) -> None:
+    """The pairing-cost telemetry of one dispatched batch: Miller-pair
+    count (the latency driver the aggregation attacks) and sets-per-pair
+    aggregation ratio (1.0-ish unaggregated; ~n/m on the mega-pairing)."""
+    metrics.BLS_MILLER_PAIRS.inc(pairs)
+    metrics.BLS_MILLER_PAIRS_LAST.set(pairs)
+    metrics.BLS_AGGREGATION_RATIO.set(n_sets / pairs)
+    if aggregated:
+        metrics.BLS_AGGREGATED_BATCHES.inc()
+
+
+def dispatch_verify_signature_sets(sets, seed=None, groups=None):
     """Async half of `verify_signature_sets`: marshal + enqueue, NO host
     sync. Returns a zero-dim device bool (materialise with `bool()`), or
     a plain python bool when a structural check or the monolith/sharded
     path already decided the batch. The pipeline (crypto/bls/pipeline.py)
-    overlaps the next batch's marshalling with this batch's device work.
+    overlaps the next batch's marshalling with this batch's device work
+    and passes the message `groups` it computed pre-marshal.
     """
     with tracing.span("bls_marshal", sets=len(sets)):
-        args = _marshal_batch(sets, seed=seed)
-    if args is None:
+        mb = _marshal_batch(sets, seed=seed, groups=groups)
+    if mb is None:
         return False
-    u, h_idx, pk_dev, sig, scalars, real = args
 
-    n_b = int(real.shape[0])
+    n_b = int(mb.real.shape[0])
     with tracing.span("bls_dispatch", bucket=n_b):
-        threshold = _shard_min_sets()
-        if threshold and n_b >= threshold and len(jax.devices()) > 1:
+        if _mesh_eligible(n_b):
             # Multi-chip hot path: shard the per-set axis over the device
             # mesh; a chip fault shrinks the mesh over survivors (per-
             # device breakers) and raises MeshEmpty only when no device
             # is usable -- which the FallbackBackend degrades to the cpu
             # oracle.
-            return _mesh_verifier().verify(
-                (jnp.take(u, h_idx, axis=0), pk_dev, sig, scalars, real)
+            _count_pairs(mb.n_sets, n_b + 1, aggregated=False)
+            out = _mesh_verifier().verify(
+                (
+                    jnp.take(mb.u, mb.h_idx, axis=0),
+                    mb.pk, mb.sig, mb.scalars, mb.real,
+                )
             )
-        if os.environ.get("LIGHTHOUSE_TPU_MONOLITH") == "1":
+        elif os.environ.get("LIGHTHOUSE_TPU_MONOLITH") == "1":
             # the monolithic program takes per-set draws (no dedup axis)
-            return verify_jit(
-                jnp.take(u, h_idx, axis=0), pk_dev, sig, scalars, real
+            _count_pairs(mb.n_sets, n_b + 1, aggregated=False)
+            out = verify_jit(
+                jnp.take(mb.u, mb.h_idx, axis=0),
+                mb.pk, mb.sig, mb.scalars, mb.real,
             )
-        return verify_device(u, h_idx, pk_dev, sig, scalars, real)
+        elif mb.grid_idx is not None:
+            # mega-pairing: Miller-pair count rides the MESSAGE bucket
+            _count_pairs(mb.n_sets, int(mb.u.shape[0]) + 1, aggregated=True)
+            out = verify_device_aggregated(
+                mb.u, mb.pk, mb.sig, mb.scalars, mb.real,
+                mb.grid_idx, mb.grid_real,
+            )
+        else:
+            _count_pairs(mb.n_sets, n_b + 1, aggregated=False)
+            out = verify_device(
+                mb.u, mb.h_idx, mb.pk, mb.sig, mb.scalars, mb.real
+            )
+    if mb.new_shape_key is not None:
+        # the jitted calls above return only once tracing + compile are
+        # done (execution stays async), so the shape's executables now
+        # exist and are persisted: safe to register for future processes
+        compile_cache.record_shape(mb.new_shape_key)
+    return out
 
 
 def verify_signature_sets(sets, seed=None) -> bool:

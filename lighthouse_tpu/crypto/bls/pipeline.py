@@ -20,25 +20,32 @@ observable result order identical to the synchronous path.
 
 Backends participate at two levels of the same module duck type:
 
-  * ``dispatch_verify_signature_sets(sets, seed=None)`` (jax_tpu): does
-    host marshalling + device enqueue, returns a zero-dim device array
-    (or a plain bool for structural early-exits). True async.
+  * ``dispatch_verify_signature_sets(sets, seed=None, groups=None)``
+    (jax_tpu): does host marshalling + device enqueue, returns a
+    zero-dim device array (or a plain bool for structural early-exits).
+    True async. Backends that accept ``groups`` get the batch's
+    message-aggregation plan (``aggregation.MessageGroups``) computed by
+    the pipeline PRE-marshal on the submit thread, so the double buffer
+    overlaps batch N+1's grouping with batch N's device work -- the
+    mega-pairing's host half rides the same overlap as limb packing.
   * ``verify_signature_sets`` only (cpu, fake, fallback): the pipeline
     degrades to compute-at-submit; futures still behave identically, so
     callers never branch on the backend.
 
 Every phase is recorded into an optional resilience ``EventLog`` --
-("pipeline_marshal" / "pipeline_dispatch" / "pipeline_resolve", batch=n)
--- which is the test surface for the double-buffer overlap contract:
-batch N+1's marshal event landing before batch N's resolve event IS the
-overlap, deterministically.
+("pipeline_marshal" / "pipeline_aggregate" / "pipeline_dispatch" /
+"pipeline_resolve", batch=n) -- which is the test surface for the
+double-buffer overlap contract: batch N+1's marshal event landing before
+batch N's resolve event IS the overlap, deterministically.
 """
 
 from __future__ import annotations
 
+import inspect
 from collections import deque
 
 from ...utils import metrics, tracing
+from . import aggregation
 
 
 class PipelineError(RuntimeError):
@@ -136,6 +143,18 @@ class VerifyPipeline:
 
         return api._ensure_backend()
 
+    @staticmethod
+    def _accepts_groups(dispatch) -> bool:
+        """True when the backend's dispatch hook takes the pre-computed
+        message-aggregation plan (the extended duck type; older stubs
+        keep working without it). Inspected per submit -- once per BATCH,
+        not per set -- rather than memoized: an id()-keyed memo would go
+        stale under bound-method id reuse."""
+        try:
+            return "groups" in inspect.signature(dispatch).parameters
+        except (TypeError, ValueError):
+            return False
+
     # -- submission ----------------------------------------------------------
 
     def submit(self, sets, seed: int | None = None) -> VerifyFuture:
@@ -154,7 +173,16 @@ class VerifyPipeline:
                 backend, "dispatch_verify_signature_sets", None
             )
             if dispatch is not None:
-                fut._value = dispatch(sets, seed=seed)
+                if self._accepts_groups(dispatch):
+                    # pre-marshal aggregation on the SUBMIT thread: the
+                    # grouping of batch N+1 overlaps batch N's device
+                    # work exactly like limb packing does
+                    with tracing.span("bls_aggregate", sets=len(sets)):
+                        groups = aggregation.group_sets(sets)
+                    self._record("pipeline_aggregate", fut.batch_id)
+                    fut._value = dispatch(sets, seed=seed, groups=groups)
+                else:
+                    fut._value = dispatch(sets, seed=seed)
             else:
                 # backend without async dispatch: compute at submit
                 fut._value = bool(

@@ -619,7 +619,9 @@ class HotColdDB:
             return None
         return self._decode_stored_block(data)
 
-    def prune_payloads(self, before_slot: int | None = None) -> int:
+    def prune_payloads(
+        self, before_slot: int | None = None, chunk_blocks: int = 128
+    ) -> int:
         """Replace stored full bellatrix blocks with their BLINDED form
         (payload -> header; block roots are identical by SSZ design), like
         `lighthouse db prune-payloads` (database_manager/src/lib.rs).
@@ -628,14 +630,24 @@ class HotColdDB:
         prunes only finalized payloads, never the head's, so the node can
         still serve full blocks over req/resp and re-notify the EL.
 
+        Commits in journaled chunks of ``chunk_blocks`` rewrites (like
+        the http reconstruct sweep), so journal size and staged memory
+        stay bounded however long the chain is: each individual block is
+        still rewritten atomically, a crash inside a chunk recovers to
+        that chunk's pre-or-post image, and a crash BETWEEN chunks leaves
+        a consistent partially-pruned store the next prune resumes over
+        (already-blinded blocks are skipped).
+
         Holds the freezer mutation lock: the prune's op list is built
         from reads of the block columns, and a concurrent migration
-        committing between those reads and this batch's commit would let
+        committing between those reads and a chunk's commit would let
         the prune resurrect a hot row the migration just deleted."""
         with self._mutation_lock:
-            return self._prune_payloads_locked(before_slot)
+            return self._prune_payloads_locked(before_slot, chunk_blocks)
 
-    def _prune_payloads_locked(self, before_slot: int | None) -> int:
+    def _prune_payloads_locked(
+        self, before_slot: int | None, chunk_blocks: int
+    ) -> int:
         from ..state_transition.per_block import payload_to_header
 
         if before_slot is None:
@@ -689,7 +701,12 @@ class HotColdDB:
                     b"bellatrix_blinded\x00" + signed_blinded.as_ssz_bytes(),
                 )
                 pruned += 1
-        # one atomic batch: a crash mid-prune can never leave a block
-        # half-rewritten or strand an unprunable mix on disk
+                if chunk_blocks and len(batch) >= chunk_blocks:
+                    # per-chunk atomic commit: bounded journal, and any
+                    # crash point recovers to a consistent image (no
+                    # block is ever half-rewritten; a partially-pruned
+                    # store is valid and resumable)
+                    batch.commit()
+                    batch = self.batch()
         batch.commit()
         return pruned

@@ -1,0 +1,148 @@
+"""Persistent on-disk XLA compilation cache, generalized into the backend.
+
+The verifier's XLA programs cost 70-360 s to compile per shape bucket
+(BENCH_r04/r05), and until now only bench.py / warm_tpu.py armed JAX's
+persistent compilation cache (the warm-cache trick in __graft_entry__).
+This module is that trick promoted to a first-class backend facility:
+
+  * ``arm(root)`` points ``jax_compilation_cache_dir`` at a partition
+    under ``root`` -- a node passes ``<datadir>/compile_cache`` (cli.py),
+    entry-point scripts pass the repo-level ``.jax_cache`` -- so compiled
+    executables are paid for once per binary, not once per process.
+  * Partitions are keyed on the backend platform, and CPU partitions are
+    additionally fingerprinted by host CPU features: XLA:CPU's AOT loader
+    aborts on entries compiled for another machine's feature set, and
+    remote-TPU sessions compile CPU stubs on the REMOTE host. A different
+    host or platform simply starts a fresh partition -- cross-poisoning
+    is impossible by construction.
+  * A sidecar ``shapes.json`` registry records every bucketed batch
+    shape whose executables a process finished compiling under the
+    partition: the backend LOOKS a shape up at marshal time
+    (``shape_on_disk``, feeding ``tpu_compile_cache_hits_total`` for
+    process-cold but disk-warm shapes) and WRITES it only after the
+    shape's first dispatch has returned (``record_shape``) -- jit
+    compilation is synchronous at call time, so by then the executables
+    exist and are persisted. A process killed mid-compile therefore
+    never registers the shape, and the next process honestly counts a
+    miss.
+
+Registry updates are atomic-rename writes; concurrent processes can lose
+an update (the next completed dispatch re-records it), which only ever
+under-counts hits -- never corrupts the registry or the cache.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import json
+import os
+
+_ARMED_DIR: str | None = None
+
+
+def host_cpu_fingerprint() -> str:
+    """Stable short hash of the host's CPU feature flags (the AOT-entry
+    compatibility domain of XLA:CPU executables)."""
+    try:
+        with open("/proc/cpuinfo") as f:
+            for line in f:
+                if line.startswith("flags"):
+                    return hashlib.sha256(line.encode()).hexdigest()[:10]
+    except OSError:
+        pass
+    import platform as _platform
+
+    return hashlib.sha256(_platform.processor().encode()).hexdigest()[:10]
+
+
+def partition(root: str) -> str:
+    """The backend-keyed cache partition under ``root`` for the platform
+    the current process will compile for (resolved WITHOUT initializing
+    the backend: a device query here would freeze the platform before an
+    entry point's own forcing could take effect)."""
+    import jax
+
+    platform = (
+        jax.config.jax_platforms
+        or os.environ.get("JAX_PLATFORMS")
+        or "device"
+    ).split(",")[0]
+    sub = f"cpu-{host_cpu_fingerprint()}" if platform == "cpu" else "tpu"
+    return os.path.join(root, sub)
+
+
+def arm(root: str) -> str:
+    """Point JAX's persistent compilation cache at this root's partition
+    and remember it for shape-registry lookups. Returns the partition
+    directory. Set ``LIGHTHOUSE_TPU_COMPILE_CACHE=0`` to refuse (test
+    suites, debugging)."""
+    global _ARMED_DIR
+    if os.environ.get("LIGHTHOUSE_TPU_COMPILE_CACHE") == "0":
+        return ""
+    import jax
+
+    part = partition(root)
+    os.makedirs(part, exist_ok=True)
+    jax.config.update("jax_compilation_cache_dir", part)
+    jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
+    jax.config.update("jax_persistent_cache_min_entry_size_bytes", 0)
+    _ARMED_DIR = part
+    return part
+
+
+def armed_dir() -> str | None:
+    return _ARMED_DIR
+
+
+def _registry_path(part: str) -> str:
+    return os.path.join(part, "shapes.json")
+
+
+def seen_shapes(part: str | None = None) -> set[str]:
+    part = part if part is not None else _ARMED_DIR
+    if not part:
+        return set()
+    try:
+        with open(_registry_path(part)) as f:
+            loaded = json.load(f)
+    except (OSError, json.JSONDecodeError):
+        return set()
+    return set(loaded) if isinstance(loaded, list) else set()
+
+
+def _shape_name(key: tuple) -> str:
+    return "x".join(str(int(v)) for v in key)
+
+
+def shape_on_disk(key: tuple, part: str | None = None) -> bool:
+    """True when a previous process finished compiling this bucketed
+    shape under the armed partition (the persistent cache holds its
+    executables: a hit for a process-cold shape). False when it is new
+    here or no cache is armed. Read-only."""
+    part = part if part is not None else _ARMED_DIR
+    if not part:
+        return False
+    return _shape_name(key) in seen_shapes(part)
+
+
+def record_shape(key: tuple, part: str | None = None) -> None:
+    """Register one bucketed shape as COMPILED under the partition. Call
+    only after the shape's first dispatch has returned -- that is the
+    point at which its executables exist and have been persisted, so a
+    crash/timeout mid-compile never leaves a phantom registry entry."""
+    part = part if part is not None else _ARMED_DIR
+    if not part:
+        return
+    shapes = seen_shapes(part)
+    name = _shape_name(key)
+    if name in shapes:
+        return
+    shapes.add(name)
+    path = _registry_path(part)
+    tmp = f"{path}.tmp.{os.getpid()}"
+    try:
+        with open(tmp, "w") as f:
+            json.dump(sorted(shapes), f)
+        os.replace(tmp, path)
+    except OSError:
+        pass  # registry is advisory telemetry; never block dispatch

@@ -326,6 +326,35 @@ BLS_BISECTION_CALLS = REGISTRY.counter(
     "bls_bisection_backend_calls_total",
     "Extra backend calls spent isolating invalid sets by bisection",
 )
+BLS_BISECTION_BAD_ITEMS = REGISTRY.counter(
+    "bls_bisection_bad_items_total",
+    "Invalid items isolated (and attributed) by the bisection fallback",
+)
+
+# -- the message-aggregation (mega-pairing) family (crypto/bls/aggregation.py
+# + backends/jax_tpu.py dispatch): pairing cost is THE batch-verification
+# latency driver, so the Miller-pair count per batch and the sets-per-pair
+# ratio are the observable face of the aggregated path.
+
+BLS_MILLER_PAIRS = REGISTRY.counter(
+    "bls_miller_pairs_total",
+    "Miller-loop pairs dispatched across all verification batches",
+)
+BLS_MILLER_PAIRS_LAST = REGISTRY.gauge(
+    "bls_miller_pairs_last_batch",
+    "Miller-loop pairs of the most recently dispatched batch (scales "
+    "with bucketed distinct messages on the aggregated path, bucketed "
+    "sets otherwise)",
+)
+BLS_AGGREGATION_RATIO = REGISTRY.gauge(
+    "bls_aggregation_ratio",
+    "Signature sets per Miller pair in the most recent batch (~1 "
+    "unaggregated; ~sets/messages on the mega-pairing path)",
+)
+BLS_AGGREGATED_BATCHES = REGISTRY.counter(
+    "bls_aggregated_batches_total",
+    "Batches verified through the per-message mega-pairing path",
+)
 
 # -- the crash-safety metric family (store/kv.py journal, store/fsck.py) ------
 # Write-ahead journal recovery outcomes and consistency-checker results:

@@ -23,7 +23,16 @@ counted. This module is that correlation layer:
     and counts) and export as Chrome trace-event JSON ("X" complete
     events, microsecond timestamps) -- loadable in Perfetto / chrome://
     tracing; served at /lighthouse/tracing/{status,dump} and dumped by
-    ``python -m lighthouse_tpu.cli trace``.
+    ``python -m lighthouse_tpu.cli trace``;
+  * under load the ring need not record every span: ``sample_rate``
+    keeps 1-in-N TRACES, decided once per trace from the root span's
+    trace id (a pure function of the id, so every span of a trace --
+    across threads, futures, and ``attach`` boundaries -- shares the
+    decision without carrying a flag). Unsampled spans still draw ids
+    and clock reads, so flipping the rate never perturbs the id/clock
+    stream of a seeded replay; they are simply not recorded (counted in
+    ``sampled_out``). Default 1.0 (record everything);
+    ``LIGHTHOUSE_TPU_TRACE_SAMPLE`` seeds the process default.
 
 The default process tracer uses a :class:`StepClock` (each read advances
 a fixed synthetic step): fully deterministic, no wall-clock read, and
@@ -117,13 +126,15 @@ class Tracer:
     stack is per-thread, the finished ring and id draws share one lock."""
 
     def __init__(self, clock=None, rng=None, capacity: int = 4096,
-                 enabled: bool = True):
+                 enabled: bool = True, sample_rate: float = 1.0):
         self.clock = clock if clock is not None else StepClock()
         self.rng = rng if rng is not None else random.Random(0)
         self.capacity = int(capacity)
         self.enabled = enabled
+        self.sample_rate = float(sample_rate)
         self.finished: deque[Span] = deque(maxlen=self.capacity)
         self.dropped = 0
+        self.sampled_out = 0
         self._lock = threading.Lock()
         self._local = threading.local()
         # thread ident -> stable small tid, first-seen order: chrome trace
@@ -234,8 +245,24 @@ class Tracer:
         s.end = s.start
         self._record(s)
 
+    def trace_sampled(self, trace_id: int) -> bool:
+        """The per-trace sampling verdict: a pure function of the trace
+        id (drawn at the ROOT span), so it is decided exactly once per
+        trace and every descendant span -- on any thread, through any
+        ``attach`` -- agrees without propagating a flag. Full-precision
+        against the 64-bit id range: any positive rate keeps a positive
+        fraction of traces (1e-6 keeps ~1-in-a-million, not zero)."""
+        if self.sample_rate >= 1.0:
+            return True
+        if self.sample_rate <= 0.0:
+            return False
+        return trace_id < self.sample_rate * 2.0**64
+
     def _record(self, span: Span) -> None:
         with self._lock:
+            if not self.trace_sampled(span.trace_id):
+                self.sampled_out += 1
+                return
             if len(self.finished) == self.finished.maxlen:
                 self.dropped += 1
             self.finished.append(span)
@@ -249,6 +276,8 @@ class Tracer:
                 "capacity": self.capacity,
                 "recorded": len(self.finished),
                 "dropped": self.dropped,
+                "sample_rate": self.sample_rate,
+                "sampled_out": self.sampled_out,
                 "threads": len(self._tids),
             }
 
@@ -292,6 +321,7 @@ class Tracer:
         with self._lock:
             self.finished.clear()
             self.dropped = 0
+            self.sampled_out = 0
             self._tids.clear()
 
 
@@ -303,7 +333,13 @@ _DEFAULT: Tracer | None = None
 def default_tracer() -> Tracer:
     global _DEFAULT
     if _DEFAULT is None:
-        _DEFAULT = Tracer()
+        import os
+
+        try:
+            rate = float(os.environ.get("LIGHTHOUSE_TPU_TRACE_SAMPLE", "1"))
+        except ValueError:
+            rate = 1.0
+        _DEFAULT = Tracer(sample_rate=rate)
     return _DEFAULT
 
 

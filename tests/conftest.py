@@ -39,6 +39,11 @@ if os.environ.get("LIGHTHOUSE_TPU_TEST_CACHE") == "1":
     from __graft_entry__ import _arm_compilation_cache  # noqa: E402
 
     _arm_compilation_cache()
+else:
+    # belt-and-braces: any code path that would arm the persistent cache
+    # mid-suite (e.g. a cli `bn` invocation with a datadir) is refused,
+    # so pytest processes can never load another process's AOT entries
+    os.environ.setdefault("LIGHTHOUSE_TPU_COMPILE_CACHE", "0")
 
 
 def pytest_configure(config):
@@ -70,6 +75,7 @@ def pytest_collection_modifyitems(session, config, items):
         "test_tpu_",
         "test_pallas_kernels",
         "test_bls_api",
+        "test_bls_aggregation",  # compiles the mega-pairing group stage
         "test_bls_edge_matrix",
         "test_pubkey_table",
         "test_known_vectors",

@@ -1,0 +1,125 @@
+"""Persistent compile cache (utils/compile_cache.py): partition keying,
+the shapes.json registry, and its surfacing through the existing
+tpu_compile_cache_{hits,misses}_total telemetry.
+
+arm() itself is NOT exercised against the live JAX config here — the
+suite runs with LIGHTHOUSE_TPU_COMPILE_CACHE=0 (conftest) precisely so
+no pytest process ever loads another process's AOT entries; these tests
+drive the registry with explicit directories instead.
+"""
+
+import json
+import os
+
+from lighthouse_tpu.utils import compile_cache as CC
+from lighthouse_tpu.utils.metrics import (
+    TPU_COMPILE_CACHE_HITS,
+    TPU_COMPILE_CACHE_MISSES,
+)
+
+
+class TestShapeRegistry:
+    def test_lookup_miss_then_recorded_hit(self, tmp_path):
+        part = str(tmp_path)
+        key = (8, 4, 4, 0)
+        assert CC.shape_on_disk(key, part=part) is False
+        CC.record_shape(key, part=part)  # "the compile completed"
+        # a fresh process consulting the same file sees it warm
+        assert CC.shape_on_disk(key, part=part) is True
+        assert CC.seen_shapes(part) == {"8x4x4x0"}
+
+    def test_distinct_shapes_accumulate(self, tmp_path):
+        part = str(tmp_path)
+        CC.record_shape((4, 4, 4, 0), part=part)
+        CC.record_shape((4, 4, 4, 4), part=part)  # aggregated-grid variant
+        assert CC.seen_shapes(part) == {"4x4x4x0", "4x4x4x4"}
+
+    def test_corrupt_registry_treated_as_empty(self, tmp_path):
+        part = str(tmp_path)
+        with open(os.path.join(part, "shapes.json"), "w") as f:
+            f.write("{not json")
+        assert CC.seen_shapes(part) == set()
+        assert CC.shape_on_disk((4, 4, 4, 0), part=part) is False
+        CC.record_shape((4, 4, 4, 0), part=part)
+        with open(os.path.join(part, "shapes.json")) as f:
+            assert json.load(f) == ["4x4x4x0"]
+
+    def test_unarmed_process_registry_is_inert(self):
+        # with no armed partition every shape is "new" and nothing is
+        # written anywhere
+        saved = CC._ARMED_DIR
+        CC._ARMED_DIR = None
+        try:
+            assert CC.shape_on_disk((99, 4, 4, 0)) is False
+            CC.record_shape((99, 4, 4, 0))  # no-op, no crash
+            assert CC.seen_shapes() == set()
+        finally:
+            CC._ARMED_DIR = saved
+
+    def test_arm_refused_by_env_kill_switch(self, tmp_path, monkeypatch):
+        monkeypatch.setenv("LIGHTHOUSE_TPU_COMPILE_CACHE", "0")
+        saved = CC._ARMED_DIR
+        assert CC.arm(str(tmp_path)) == ""
+        assert CC._ARMED_DIR == saved  # untouched: nothing was armed
+
+    def test_partition_is_platform_keyed(self, tmp_path):
+        part = CC.partition(str(tmp_path))
+        # conftest forces the cpu platform: the partition must carry the
+        # host fingerprint so foreign AOT entries can never be loaded
+        assert os.path.basename(part).startswith("cpu-")
+        assert os.path.dirname(part) == str(tmp_path)
+
+
+class TestTelemetrySurfacing:
+    def test_disk_warm_shape_counts_as_compile_cache_hit(self, tmp_path):
+        """A shape this process never marshalled, but a previous process
+        finished compiling: tpu_compile_cache_hits_total, not a miss."""
+        from lighthouse_tpu.crypto.bls.backends import jax_tpu
+
+        part = str(tmp_path)
+        key = (512, 8, 16, 0)
+        CC.record_shape(key, part=part)  # "a previous process compiled it"
+        saved_dir = CC._ARMED_DIR
+        saved_seen = set(jax_tpu._seen_shape_buckets)
+        CC._ARMED_DIR = part
+        jax_tpu._seen_shape_buckets.discard(key)
+        hits = TPU_COMPILE_CACHE_HITS.value
+        misses = TPU_COMPILE_CACHE_MISSES.value
+        try:
+            assert jax_tpu._count_shape_bucket(*key) is None
+            assert TPU_COMPILE_CACHE_HITS.value == hits + 1
+            assert TPU_COMPILE_CACHE_MISSES.value == misses
+            # and the second marshal of the same shape is an in-process hit
+            assert jax_tpu._count_shape_bucket(*key) is None
+            assert TPU_COMPILE_CACHE_HITS.value == hits + 2
+        finally:
+            CC._ARMED_DIR = saved_dir
+            jax_tpu._seen_shape_buckets.clear()
+            jax_tpu._seen_shape_buckets.update(saved_seen)
+
+    def test_cold_shape_is_a_miss_and_registers_only_after_dispatch(
+        self, tmp_path
+    ):
+        """The marshal-time count returns the key for a cold shape but
+        does NOT write the registry -- a process killed mid-compile must
+        not leave a phantom warm entry. The dispatcher registers the key
+        once the compile has actually completed."""
+        from lighthouse_tpu.crypto.bls.backends import jax_tpu
+
+        part = str(tmp_path)
+        key = (1024, 8, 16, 32)
+        saved_dir = CC._ARMED_DIR
+        saved_seen = set(jax_tpu._seen_shape_buckets)
+        CC._ARMED_DIR = part
+        jax_tpu._seen_shape_buckets.discard(key)
+        misses = TPU_COMPILE_CACHE_MISSES.value
+        try:
+            assert jax_tpu._count_shape_bucket(*key) == key
+            assert TPU_COMPILE_CACHE_MISSES.value == misses + 1
+            assert CC.seen_shapes(part) == set()  # not yet: compile pending
+            CC.record_shape(key)  # what dispatch does after returning
+            assert "1024x8x16x32" in CC.seen_shapes(part)
+        finally:
+            CC._ARMED_DIR = saved_dir
+            jax_tpu._seen_shape_buckets.clear()
+            jax_tpu._seen_shape_buckets.update(saved_seen)

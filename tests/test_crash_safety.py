@@ -457,9 +457,12 @@ class TestSchemaMigrationCrashMatrix:
 
 @pytest.mark.crash
 class TestPrunePayloadsCrashMatrix:
-    def test_crash_at_every_op_of_prune(self):
-        """Payload pruning is one batch: any crash index recovers to the
-        fully-pruned or fully-unpruned image (roots identical anyway)."""
+    def test_crash_at_every_op_of_every_prune_chunk(self):
+        """Payload pruning commits in per-N-block chunks (bounded journal,
+        like http reconstruct): every chunk is atomic, so any crash index
+        recovers to that chunk's pre-or-post image -- a partially-pruned
+        store is consistent (roots identical by SSZ design) and the next
+        prune resumes over it."""
         from lighthouse_tpu.execution_layer import (
             ExecutionLayer,
             MockExecutionEngine,
@@ -478,12 +481,17 @@ class TestPrunePayloadsCrashMatrix:
         assert h.chain.head_state.fork_name == "bellatrix"
         batches_before = len(kv.batches)
         n = h.store.prune_payloads(
-            before_slot=int(h.chain.head_state.slot) + 1
+            before_slot=int(h.chain.head_state.slot) + 1, chunk_blocks=2
         )
         assert n >= 3
-        pre, ops = kv.batches[batches_before]
-        assert len(ops) == n
-        crash_matrix(pre, ops, _open_minimal(spec))
+        chunks = kv.batches[batches_before:]
+        # the single-batch shape is gone: the prune landed as >= 2 bounded
+        # chunks that together cover every pruned block exactly once
+        assert len(chunks) >= 2
+        assert all(1 <= len(ops) <= 2 for _, ops in chunks)
+        assert sum(len(ops) for _, ops in chunks) == n
+        for pre, ops in chunks:
+            crash_matrix(pre, ops, _open_minimal(spec))
 
 
 # --- FileStore durability ---------------------------------------------------

@@ -56,6 +56,75 @@ class TestClocks:
         assert tc.now() == 10.5
 
 
+# -- unit: per-trace sampling -------------------------------------------------
+
+
+class TestTraceSampling:
+    def test_default_rate_records_everything(self):
+        t = tracing.Tracer(rng=random.Random(1))
+        with t.span("root"):
+            with t.span("child"):
+                pass
+        assert len(t.finished) == 2
+        assert t.status()["sample_rate"] == 1.0
+        assert t.status()["sampled_out"] == 0
+
+    def test_rate_zero_records_nothing_but_counts(self):
+        t = tracing.Tracer(rng=random.Random(1), sample_rate=0.0)
+        with t.span("root"):
+            with t.span("child"):
+                pass
+        t.instant("edge")
+        assert len(t.finished) == 0
+        assert t.status()["sampled_out"] == 3
+
+    def test_decision_is_per_trace_and_all_or_nothing(self):
+        """Every span of a trace shares the root's verdict: traces are
+        recorded whole or dropped whole, never torn."""
+        from collections import Counter
+
+        t = tracing.Tracer(rng=random.Random(3), sample_rate=0.5)
+        total = 40
+        for _ in range(total):
+            with t.span("root"):
+                with t.span("child"):
+                    pass
+        per_trace = Counter(s.trace_id for s in t.finished)
+        assert all(count == 2 for count in per_trace.values())
+        assert 0 < len(per_trace) < total  # some kept, some shed
+        assert t.status()["sampled_out"] == 2 * (total - len(per_trace))
+
+    def test_sampling_never_perturbs_the_id_stream(self):
+        """Unsampled spans still draw ids/clock reads, so a replay at a
+        different rate sees identical ids for the spans it does keep."""
+        full = tracing.Tracer(rng=random.Random(9))
+        half = tracing.Tracer(rng=random.Random(9), sample_rate=0.5)
+        for t in (full, half):
+            for _ in range(20):
+                with t.span("root"):
+                    pass
+        all_ids = [(s.trace_id, s.span_id) for s in full.finished]
+        kept_ids = [(s.trace_id, s.span_id) for s in half.finished]
+        assert 0 < len(kept_ids) < len(all_ids)
+        assert [x for x in all_ids if half.trace_sampled(x[0])] == kept_ids
+
+    def test_reset_clears_sampled_out(self):
+        t = tracing.Tracer(rng=random.Random(1), sample_rate=0.0)
+        with t.span("root"):
+            pass
+        assert t.status()["sampled_out"] == 1
+        t.reset()
+        assert t.status()["sampled_out"] == 0
+
+    def test_env_seeds_the_default_tracer_rate(self, monkeypatch):
+        monkeypatch.setenv("LIGHTHOUSE_TPU_TRACE_SAMPLE", "0.25")
+        tracing._DEFAULT = None
+        try:
+            assert tracing.default_tracer().sample_rate == 0.25
+        finally:
+            tracing._DEFAULT = None
+
+
 # -- unit: tracer mechanics ---------------------------------------------------
 
 

@@ -2,7 +2,7 @@
 
 PY ?= python
 
-.PHONY: lint lint-baseline test test-lint test-chaos test-crash
+.PHONY: lint lint-baseline test test-lint test-chaos test-crash test-scenario
 
 ## lint: AST consensus-safety & TPU-hazard pass (tools/lint, stdlib-only)
 lint:
@@ -32,3 +32,9 @@ test-chaos:
 test-crash:
 	JAX_PLATFORMS=cpu $(PY) -m pytest tests/test_crash_safety.py -q \
 		-p no:cacheprovider
+
+## test-scenario: full adversarial scenario matrix incl. slow scale runs
+## (the CI scenario job; tier-1 keeps only the small seeded scenario)
+test-scenario:
+	JAX_PLATFORMS=cpu $(PY) -m pytest tests/test_scenarios.py -q \
+		-m scenario -p no:cacheprovider

@@ -223,43 +223,69 @@ class BeaconChain:
         cls, store: HotColdDB, preset: Preset, spec, slot_clock=None
     ) -> "BeaconChain":
         """Node-restart resume (ClientGenesis::FromStore): reload the
-        persisted head and continue.
+        persisted chain and continue.
+
+        Fork choice is re-anchored at the persisted FINALIZED checkpoint
+        (when one resolves) and rebuilt by replaying the store's hot
+        blocks above it — the seat of the reference's persisted fork
+        choice. Anchoring at the raw head pointer would pin the proto
+        array to whatever block happened to be head at the crash; if
+        that block was a PRIVATE fork (produced and imported locally,
+        killed before gossip), the node could never reorg onto the
+        canonical chain its peers extended — the stuck-forever state the
+        crash-recovery scenario asserts against.
 
         A corrupt head pointer (head_block_root that resolves to no
         stored block/state) is survivable: the node logs loudly and
         falls back to the persisted finalized checkpoint — losing the
         unfinalized tip beats refusing to start (the reference recovers
         the same way via fork_revert / the anchor on disk)."""
+        from ..store.kv import Column as _Col
+
         head_root = store.get_chain_item(b"head_block_root")
         state_root = store.get_chain_item(b"head_state_root")
         if head_root is None or state_root is None:
             raise BlockError("store holds no persisted chain")
-        state = None
-        if store.get_chain_item(b"block_post_state:" + head_root) is not None:
-            try:
-                # get_state replays from the nearest stored snapshot when
-                # the head landed between snapshot slots (summary entry)
-                state = store.get_state(state_root)
-            except StoreError:
-                state = None
-        if state is None:
-            fallback = store.get_chain_item(
-                b"finalized_block_root"
-            ) or store.get_chain_item(b"genesis_block_root")
-            fb_state_root = fallback and store.get_chain_item(
-                b"block_post_state:" + fallback
-            )
-            if fb_state_root is None:
-                raise BlockError("persisted head state missing")
+        # cheap head-resolvability probe (the fsck check): mapping exists
+        # and the state row (full or summary) is present. The expensive
+        # get_state replay of the head is NOT paid when the finalized
+        # anchor is used — _replay_hot_blocks rebuilds the tip anyway.
+        mapped = store.get_chain_item(b"block_post_state:" + head_root)
+        head_resolvable = mapped is not None and (
+            store.kv.get(_Col.STATE, mapped) is not None
+            or store.kv.get(_Col.STATE_SUMMARY, mapped) is not None
+        )
+        if not head_resolvable:
             from ..utils.logging import Logger
 
             Logger(level="error").child(service="chain").crit(
                 "head pointer corrupt; falling back to finalized checkpoint",
                 head=head_root.hex(),
-                fallback=fallback.hex(),
             )
+        # pre-finality chains have no finalized_block_root yet: the
+        # finalized checkpoint IS genesis, so anchor there
+        fin_root = store.get_chain_item(
+            b"finalized_block_root"
+        ) or store.get_chain_item(b"genesis_block_root")
+        anchor_state = None
+        if fin_root is not None and (
+            fin_root != head_root or not head_resolvable
+        ):
+            fin_state_root = store.get_chain_item(
+                b"block_post_state:" + fin_root
+            )
+            if fin_state_root is not None:
+                try:
+                    anchor_state = store.get_state(fin_state_root)
+                except StoreError:
+                    anchor_state = None  # fall through to head anchoring
+        if anchor_state is None:
+            if not head_resolvable:
+                raise BlockError("persisted head state missing")
             try:
-                state = store.get_state(fb_state_root)
+                # get_state replays from the nearest stored snapshot when
+                # the head landed between snapshot slots (summary entry)
+                anchor_state = store.get_state(state_root)
             except StoreError as e:
                 raise BlockError(
                     f"persisted head AND finalized states missing: {e}"
@@ -269,12 +295,69 @@ class BeaconChain:
         # so there is no crash window that could tear the anchor
         oldest = store.get_chain_item(b"oldest_block_root")
         meta = store.get_chain_item(b"oldest_block_meta")
-        chain = cls(store, state, preset, spec, slot_clock=slot_clock)
+        chain = cls(store, anchor_state, preset, spec, slot_clock=slot_clock)
         if oldest is not None and meta is not None:
             chain.oldest_block_root = oldest
             chain.oldest_block_slot = int.from_bytes(meta[:8], "little")
             chain.oldest_block_parent = meta[8:]
+        # pass the ORIGINAL head pointer: __init__ just re-persisted the
+        # anchor as the head, so the store's copy no longer names the tip
+        chain._replay_hot_blocks(head_root)
         return chain
+
+    def _replay_hot_blocks(self, persisted_head: bytes | None = None) -> None:
+        """Rebuild fork choice from the store's hot blocks above the
+        anchor (FromStore's persisted-fork-choice seat): every stored
+        non-finalized fork re-imports in slot order, so the resumed node
+        can still reorg between them once votes arrive. Signature
+        re-verification is skipped — these blocks were verified before
+        they were stored. Blocks that no longer attach (pruned parents,
+        stale sub-finality forks) are skipped; resume must not refuse to
+        start over a dangling row."""
+        from ..store.kv import Column as _Col
+
+        anchor_slot = int(self.head_state.slot)
+        by_root: dict[bytes, object] = {}
+        for root in self.store.kv.keys(_Col.BLOCK):
+            blk = self.store.get_block(root)
+            if blk is not None and int(blk.message.slot) > anchor_slot:
+                by_root[bytes(root)] = blk
+        # the persisted head's ancestry may dip into the FREEZER: a crash
+        # between a migration's content sub-batches and its split-slot
+        # marker leaves canonical blocks frozen while the stale marker
+        # anchors us below them — walk the head pointer down to the
+        # anchor through both temperatures so the tip still re-imports
+        root = (
+            persisted_head
+            if persisted_head is not None
+            else self.store.get_chain_item(b"head_block_root")
+        )
+        while root and any(root):
+            r = bytes(root)
+            blk = by_root.get(r) or self.store.get_block_any_temperature(r)
+            if blk is None or int(blk.message.slot) <= anchor_slot:
+                break
+            by_root[r] = blk
+            root = bytes(blk.message.parent_root)
+        blocks = list(by_root.values())
+        if not blocks:
+            return
+        blocks.sort(key=lambda b: (int(b.message.slot), b.message.tree_hash_root()))
+        set_slot = getattr(self.slot_clock, "set_slot", None)
+        if set_slot is not None:
+            set_slot(
+                max(
+                    self.current_slot,
+                    max(int(b.message.slot) for b in blocks),
+                )
+            )
+        for blk in blocks:
+            try:
+                self.process_block(
+                    blk, strategy=BlockSignatureStrategy.NO_VERIFICATION
+                )
+            except BlockError:
+                continue
 
     # -- time ----------------------------------------------------------------
 
@@ -518,13 +601,22 @@ class BeaconChain:
         execution_status, execution_block_hash,
     ) -> None:
         block = signed_block.message
-        self.fork_choice.on_block(
-            signed_block,
-            block_root,
-            state,
-            execution_status=execution_status,
-            execution_block_hash=execution_block_hash,
-        )
+        try:
+            self.fork_choice.on_block(
+                signed_block,
+                block_root,
+                state,
+                execution_status=execution_status,
+                execution_block_hash=execution_block_hash,
+            )
+        except ForkChoiceError as e:
+            # surface fork-choice admission failures (e.g. a fork that no
+            # longer descends from the finalized checkpoint — exactly what
+            # a healed partition's losing side gossips) as BlockError so
+            # every import caller's reject handling covers them; the block
+            # row already committed, which is harmless (it is unreachable
+            # from fork choice and dedup treats a retry as duplicate)
+            raise BlockError(str(e)) from None
         if execution_status == "valid":
             # engine-API semantics: a VALID payload implies its ancestors'
             # payloads are valid too -- clear any stale optimistic marks

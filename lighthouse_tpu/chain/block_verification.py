@@ -48,6 +48,20 @@ class BlockAlreadyKnown(BlockError):
         self.block_root = bytes(block_root)
 
 
+class BlockEquivocation(BlockError):
+    """A signature-valid SECOND distinct block from a (slot, proposer)
+    already observed with a verified block: spec gossip validation
+    IGNOREs it (no penalty — the relayer may be honest relaying a real
+    equivocation), it must not import via gossip, and the caller should
+    hand the header to the slasher."""
+
+    def __init__(self, block_root: bytes):
+        super().__init__(
+            f"proposer equivocation {bytes(block_root).hex()[:12]}"
+        )
+        self.block_root = bytes(block_root)
+
+
 @dataclass
 class GossipVerifiedBlock:
     signed_block: object
@@ -151,8 +165,16 @@ class SignatureVerifiedBlock:
         )
 
 
-def process_gossip_block(chain: BeaconChain, signed_block) -> bytes:
-    """The full gossip pipeline in order (gossip_methods.rs:656 -> 927)."""
+def process_gossip_block(
+    chain: BeaconChain, signed_block, observed_producers=None
+) -> bytes:
+    """The full gossip pipeline in order (gossip_methods.rs:656 -> 927).
+
+    `observed_producers` (an ObservedBlockProducers) is consulted AFTER
+    every signature verifies — recording only verified blocks, exactly
+    like the reference — and a signature-valid second distinct block
+    from the same (slot, proposer) raises BlockEquivocation instead of
+    importing."""
     from ..utils import metrics as M
     from ..utils import tracing
 
@@ -163,6 +185,13 @@ def process_gossip_block(chain: BeaconChain, signed_block) -> bytes:
             gv = GossipVerifiedBlock.verify(chain, signed_block)
         with tracing.span("block_signature_verify"):
             sv = SignatureVerifiedBlock.from_gossip_verified(chain, gv)
+        if observed_producers is not None:
+            block = signed_block.message
+            verdict = observed_producers.observe(
+                block.slot, block.proposer_index, sv.block_root
+            )
+            if verdict == "equivocation":
+                raise BlockEquivocation(sv.block_root)
         # every signature checked: the reference's beacon_block_delay_
         # gossip_verification milestone (slot clock, replayable)
         M.observe_slot_delay(

@@ -624,6 +624,40 @@ def cmd_trace(args):
     return 0
 
 
+# --- scenario harness (harness/scenario.py) ---------------------------------
+
+
+def cmd_scenario(args):
+    """Run a named adversarial scenario (partitions, churn, equivocation
+    storms, long non-finality, crash-recovery) on the in-process
+    simulator: seeded, invariant-checked every slot, SLO-checked at the
+    end; --replay proves bit-identical trace export across two runs."""
+    from .harness.scenario import PLANS, assert_bit_identical_replay, run_scenario
+
+    if args.list:
+        for name in sorted(PLANS):
+            print(name)
+        return 0
+    if args.name not in PLANS:
+        raise SystemExit(
+            f"unknown scenario {args.name!r}; --list shows the catalogue"
+        )
+    plan = PLANS[args.name](
+        seed=args.seed, nodes=args.nodes, validators=args.validators
+    )
+    if args.replay:
+        result, _second = assert_bit_identical_replay(plan)
+        result.report["replay_bit_identical"] = True
+    else:
+        result = run_scenario(plan)
+    if args.out:
+        with open(args.out, "w") as f:
+            f.write(result.trace)
+        result.report["trace_path"] = args.out
+    print(json.dumps(result.report, indent=1))
+    return 0 if not result.report["slo"]["failures"] else 1
+
+
 # --- dev tools (reference lcli/src/main.rs:54-610) -------------------------
 
 
@@ -858,6 +892,25 @@ def main(argv=None) -> int:
     trace.add_argument("--capacity", type=int, default=65536,
                        help="span ring size for the demo tracer")
     trace.set_defaults(fn=cmd_trace)
+
+    scen = sub.add_parser(
+        "scenario",
+        help="run a deterministic adversarial scenario on the simulator",
+    )
+    _add_network_args(scen)
+    scen.add_argument("--name", default="partition",
+                      help="scenario family (--list shows the catalogue)")
+    scen.add_argument("--list", action="store_true",
+                      help="list the scenario catalogue and exit")
+    scen.add_argument("--seed", type=int, default=0)
+    scen.add_argument("--nodes", type=int, default=4)
+    scen.add_argument("--validators", type=int, default=64)
+    scen.add_argument("--replay", action="store_true",
+                      help="run twice and assert bit-identical trace "
+                           "export + final heads")
+    scen.add_argument("--out", default=None,
+                      help="write the Chrome trace-event JSON here")
+    scen.set_defaults(fn=cmd_scenario)
 
     tools = sub.add_parser("tools", help="dev tools (lcli)")
     _add_network_args(tools)

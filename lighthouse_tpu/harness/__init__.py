@@ -1,5 +1,15 @@
 """In-process test harnesses (reference beacon_chain/src/test_utils.rs +
-testing/: BeaconChainHarness, EphemeralHarnessType, manual clocks)."""
+testing/: BeaconChainHarness, EphemeralHarnessType, manual clocks) and
+the deterministic adversarial scenario harness (scenario.py)."""
 
 from .beacon_chain_harness import BeaconChainHarness  # noqa: F401
 from .chain import StateHarness  # noqa: F401
+from .scenario import (  # noqa: F401
+    PLANS,
+    InvariantViolation,
+    Phase,
+    ScenarioPlan,
+    SLO,
+    assert_bit_identical_replay,
+    run_scenario,
+)

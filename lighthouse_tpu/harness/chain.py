@@ -108,9 +108,12 @@ class StateHarness:
             target=Checkpoint(epoch=epoch, root=target_root),
         )
 
-    def attestations_for_slot(self, state, slot: int):
-        """Full-participation attestations for every committee at `slot`
-        (state must be at or past `slot`)."""
+    def attestations_for_slot(self, state, slot: int, validators=None):
+        """Attestations for every committee at `slot` (state must be at
+        or past `slot`). Full participation by default; `validators` (a
+        container of validator indices) restricts the set bits to its
+        members — the scenario harness's partition/withholding seat. A
+        committee with no participating member yields no attestation."""
         t = types_for(self.preset)
         epoch = compute_epoch_at_slot(slot, self.preset)
         ctxt = ConsensusContext(self.preset, self.spec)
@@ -118,6 +121,14 @@ class StateHarness:
         out = []
         for index in range(cache.committees_per_slot):
             committee = cache.get_beacon_committee(slot, index)
+            if validators is None:
+                bits = tuple(True for _ in committee)
+                signers = list(committee)
+            else:
+                bits = tuple(v in validators for v in committee)
+                signers = [v for v in committee if v in validators]
+                if not signers:
+                    continue
             data = self.attestation_data_for(state, slot, index)
             if self.sign:
                 domain = get_domain(
@@ -127,7 +138,7 @@ class StateHarness:
                 agg = AggregateSignature.aggregate(
                     [
                         Signature.from_bytes(self._sign_root(root, v))
-                        for v in committee
+                        for v in signers
                     ]
                 )
                 sig = agg.to_bytes()
@@ -135,7 +146,7 @@ class StateHarness:
                 sig = INFINITY_SIGNATURE
             out.append(
                 t.Attestation(
-                    aggregation_bits=tuple(True for _ in committee),
+                    aggregation_bits=bits,
                     data=data,
                     signature=sig,
                 )
@@ -212,9 +223,13 @@ class StateHarness:
 
     # -- block production ----------------------------------------------------
 
-    def produce_block(self, slot: int, attestations=(), base_state=None):
+    def produce_block(
+        self, slot: int, attestations=(), base_state=None, graffiti=None
+    ):
         """Produce a signed block at `slot` on `base_state` (default: the
-        linear head state). Returns (signed_block, post_state)."""
+        linear head state). Returns (signed_block, post_state).
+        `graffiti` distinguishes otherwise-identical blocks (the scenario
+        harness's equivocation pairs)."""
         state = clone_state(base_state if base_state is not None else self.state)
         state = process_slots(state, slot, self.preset, self.spec)
         fork = state.fork_name
@@ -226,6 +241,8 @@ class StateHarness:
         body.randao_reveal = self._randao_reveal(state, proposer)
         body.eth1_data = state.eth1_data
         body.attestations = tuple(attestations)
+        if graffiti is not None:
+            body.graffiti = bytes(graffiti)[:32].ljust(32, b"\x00")
         if hasattr(body, "sync_aggregate"):
             # empty participation signs nothing: infinity signature (spec's
             # valid empty aggregate; SSZ default zero bytes do not parse)

@@ -1,0 +1,654 @@
+"""Deterministic large-scale scenario harness (the reference's
+testing/simulator driven to adversarial conditions): a seeded,
+bit-replayable runner that drives tens-to-hundreds of in-process nodes
+through composable adversarial phases — network partitions, peer churn,
+equivocation storms, long non-finality, mid-scenario crash-recovery —
+while an invariant checker asserts consensus SAFETY every slot and an
+SLO checker asserts LIVENESS/latency properties at scenario end from the
+shared metrics registry and the exported trace.
+
+The DSL: a :class:`ScenarioPlan` is a seed plus an ordered tuple of
+:class:`Phase` knobs (split/heal, withhold fraction, storm cadences,
+crash schedule, churn) and an :class:`SLO` budget. ``run_scenario``
+executes it; running the same plan twice exports a byte-identical trace
+and identical final heads (``assert_bit_identical_replay``) — every
+source of schedule is a ``random.Random(seed)``, every clock injected.
+
+Safety invariants (asserted every slot, every live honest node):
+  * finality is monotonic per node;
+  * no two honest nodes ever finalize different roots at one epoch
+    (single finalized chain);
+  * the head never sits below the finalized slot, and descends from the
+    finalized block;
+  * no Byzantine artifact (forged block, equivocating second proposal)
+    is ever imported via gossip by an honest node.
+
+Liveness/SLO properties (scenario end, windowed over the run):
+  * post-heal/post-recovery finality reaches the plan's floor and heads
+    converge;
+  * p95 `beacon_block_{observed,imported}_delay_seconds` within bounds;
+  * retry/breaker/bisection counters within budget;
+  * every node's store is `db fsck`-clean (including the freezer
+    decodability walk) — the crash-recovery and long-non-finality
+    scenarios lean on this.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import random
+from dataclasses import dataclass, field
+
+from ..resilience.crash import CrashPlan
+from ..resilience.faults import FaultPlan
+from ..resilience.primitives import VirtualClock
+from ..types import MINIMAL, ChainSpec
+from ..utils import metrics as M
+from ..utils import tracing
+
+
+class InvariantViolation(AssertionError):
+    """A consensus-safety invariant failed; scenarios fail FAST."""
+
+
+@dataclass(frozen=True)
+class Phase:
+    """One adversarial phase: `slots` of simulated time under these
+    knobs. Knobs compose — a phase can partition AND storm AND crash."""
+
+    name: str
+    slots: int
+    # network: node-index groups that cannot reach each other; heal=True
+    # removes any split and range-syncs everyone at phase start
+    partition: tuple = None
+    heal: bool = False
+    # participation: fraction of validators withheld (offline) this phase
+    withhold_fraction: float = 0.0
+    # storms (every N slots of the phase; 0 = never)
+    equivocate_every: int = 0
+    forge_every: int = 0
+    conflicting_atts_every: int = 0
+    # churn at phase start
+    join_nodes: int = 0
+    leave_nodes: tuple = ()
+    rejoin_nodes: tuple = ()
+    # crash-recovery: arm node `crash_node`'s CrashPlan to die
+    # `crash_after_ops` store mutations into the phase; the runner
+    # reopens it (WAL recovery + fsck + re-sync) when it dies
+    crash_node: int | None = None
+    crash_after_ops: int = 20
+    crash_action: str = "after"
+    # transport fault rates for the phase (seeded FaultPlan on req/resp)
+    error_rate: float = 0.0
+    delay_rate: float = 0.0
+
+
+@dataclass(frozen=True)
+class SLO:
+    """End-of-scenario liveness/latency budget."""
+
+    finality_min_epoch: int = 1
+    heads_converge: bool = True
+    observed_delay_p95_s: float | None = None
+    imported_delay_p95_s: float | None = None
+    max_retry_attempts: int | None = None
+    max_breaker_transitions: int | None = None
+    max_bisection_calls: int | None = None
+    expect_proposer_slashings: bool = False
+    fsck_clean: bool = True
+
+
+@dataclass(frozen=True)
+class ScenarioPlan:
+    name: str
+    seed: int = 0
+    node_count: int = 4
+    validator_count: int = 64
+    phases: tuple = ()
+    slo: SLO = field(default_factory=SLO)
+    attach_slashers: bool = False
+    # small values force multi-window hot->cold migrations (the
+    # long-non-finality plan exercises the sub-batched path on purpose)
+    migration_chunk_slots: int | None = None
+
+
+@dataclass
+class ScenarioResult:
+    report: dict
+    trace: str  # Chrome trace-event JSON, byte-comparable across replays
+
+
+class InvariantChecker:
+    """Consensus safety as machine-checked properties, every slot."""
+
+    def __init__(self, sim):
+        self.sim = sim
+        self.checked_slots = 0
+        self._finalized_by_peer: dict[str, int] = {}
+        self._finalized_roots: dict[int, bytes] = {}
+
+    def _fail(self, msg: str) -> None:
+        raise InvariantViolation(msg)
+
+    def note_restart(self, node) -> None:
+        """A node resumed FromStore after a crash: its fork choice
+        re-anchors on the persisted head state, whose finalized field may
+        trail what the dead process had REALIZED in memory at an epoch
+        boundary — that is restart semantics, not a safety regression.
+        Reset the peer's monotonicity floor to the resumed value; the
+        cross-node epoch→root map still catches any conflicting
+        re-finalization."""
+        self._finalized_by_peer[node.peer_id] = int(
+            node.chain.finalized_checkpoint[0]
+        )
+
+    def check_slot(self, slot: int) -> None:
+        self.checked_slots += 1
+        spe = self.sim.preset.slots_per_epoch
+        for node in self.sim.nodes:
+            chain = node.chain
+            fe, fr = chain.finalized_checkpoint
+            fe, fr = int(fe), bytes(fr)
+            prev = self._finalized_by_peer.get(node.peer_id, 0)
+            if fe < prev:
+                self._fail(
+                    f"slot {slot}: finality regressed on {node.peer_id}: "
+                    f"{fe} < {prev}"
+                )
+            self._finalized_by_peer[node.peer_id] = fe
+            if fe > 0:
+                seen = self._finalized_roots.get(fe)
+                if seen is None:
+                    self._finalized_roots[fe] = fr
+                elif seen != fr:
+                    self._fail(
+                        f"slot {slot}: CONFLICTING finalized checkpoints "
+                        f"at epoch {fe}: {seen.hex()[:12]} vs {fr.hex()[:12]}"
+                    )
+                if chain.head_state.slot < fe * spe:
+                    self._fail(
+                        f"slot {slot}: {node.peer_id} head slot "
+                        f"{chain.head_state.slot} below finalized epoch {fe}"
+                    )
+                self._check_descent(node, slot, fe, fr)
+        for root in self.sim.forged_roots + self.sim.equivocation_roots:
+            for node in self.sim.nodes:
+                if root in node.chain._states:
+                    self._fail(
+                        f"slot {slot}: honest {node.peer_id} imported "
+                        f"Byzantine block {root.hex()[:12]} via gossip"
+                    )
+
+    def _check_descent(self, node, slot, fin_epoch, fin_root) -> None:
+        """The head must descend from the finalized block (walk bounded
+        head ancestry through the store, both temperatures). A chain
+        anchored ABOVE the finalized block (post-crash FromStore resume)
+        is unverifiable and passes."""
+        chain = node.chain
+        if fin_root in (chain.genesis_block_root, chain.head_root):
+            return
+        fin_blk = chain.store.get_block_any_temperature(fin_root)
+        if fin_blk is None:
+            return  # genesis header / below this node's anchor
+        fin_slot = int(fin_blk.message.slot)
+        root = chain.head_root
+        for _ in range(4096):
+            if root == fin_root:
+                return
+            blk = chain.store.get_block_any_temperature(root)
+            if blk is None:
+                return  # walked below the node's anchor: unverifiable
+            if int(blk.message.slot) < fin_slot:
+                self._fail(
+                    f"slot {slot}: {node.peer_id} head does not descend "
+                    f"from its finalized block {fin_root.hex()[:12]}"
+                )
+            root = bytes(blk.message.parent_root)
+            if not any(root):
+                return
+        self._fail(f"slot {slot}: ancestry walk exceeded bound")
+
+
+def _counter_snapshot() -> dict:
+    return {
+        "retry_attempts": M.RETRY_ATTEMPTS.value,
+        "breaker_transitions": M.BREAKER_TRANSITIONS.value,
+        "bisection_calls": M.BLS_BISECTION_CALLS.value,
+    }
+
+
+def run_scenario(plan: ScenarioPlan) -> ScenarioResult:
+    """Execute a plan start to finish; raises InvariantViolation on any
+    safety failure, returns the report + trace (SLO failures are listed
+    in the report — callers/CI gate on them). The BLS backend is swapped
+    to "fake" for the run (scenarios exercise consensus, not pairings)
+    and RESTORED on exit — an embedding process must not be left with an
+    always-accept verifier."""
+    from ..crypto.bls import get_backend_name, set_backend
+
+    prior_backend = get_backend_name()
+    try:
+        return _run_scenario(plan)
+    finally:
+        set_backend(prior_backend)
+
+
+def _run_scenario(plan: ScenarioPlan) -> ScenarioResult:
+    from ..crypto.bls import set_backend
+    from ..network.simulator import Simulator
+    from ..store.fsck import run_fsck
+
+    from ..crypto.bls import pipeline as bls_pipeline
+
+    set_backend("fake")
+    tracer = tracing.configure(
+        rng=random.Random(plan.seed),
+        clock=tracing.StepClock(step=1e-6),
+        capacity=1 << 16,
+    )
+    # fresh verify pipeline: its batch ids are process-global and ride
+    # span attributes, so a second run must restart the numbering or the
+    # replay's trace bytes diverge
+    bls_pipeline.configure()
+    spec = ChainSpec.interop()
+    preset = MINIMAL
+    needs_faults = any(p.error_rate or p.delay_rate for p in plan.phases)
+    fault_plan = (
+        FaultPlan(seed=plan.seed, clock=VirtualClock())
+        if needs_faults
+        else None
+    )
+    crash_plans = {
+        p.crash_node: CrashPlan(seed=plan.seed)
+        for p in plan.phases
+        if p.crash_node is not None
+    }
+    sim = Simulator(
+        plan.node_count,
+        plan.validator_count,
+        preset,
+        spec,
+        fault_plan=fault_plan,
+        crash_plans=crash_plans,
+        attach_slashers=plan.attach_slashers,
+        migration_chunk_slots=plan.migration_chunk_slots,
+    )
+    checker = InvariantChecker(sim)
+    base_counts = _counter_snapshot()
+    observed_base = M.BLOCK_OBSERVED_DELAY.snapshot()
+    imported_base = M.BLOCK_IMPORTED_DELAY.snapshot()
+
+    left_peers: set[str] = set()
+    crash_recoveries: list[dict] = []
+    slot = 1
+    for pi, phase in enumerate(plan.phases):
+        prng = random.Random(plan.seed * 1000003 + pi)
+        if phase.heal:
+            sim.heal()
+            sim.sync_all()
+        for idx in phase.leave_nodes:
+            for n in list(sim.nodes):
+                if getattr(n, "sim_index", None) == idx:
+                    left_peers.add(n.peer_id)
+                    sim.remove_node(n)
+        for idx in phase.rejoin_nodes:
+            for n in list(sim.dead):
+                if getattr(n, "sim_index", None) == idx:
+                    left_peers.discard(n.peer_id)
+                    rejoined = sim.rejoin_node(n)
+                    rejoined.range_sync()
+        for _ in range(phase.join_nodes):
+            joined = sim.add_node()
+            joined.range_sync()
+        if phase.partition is not None:
+            _partition_by_sim_index(sim, phase.partition)
+        if fault_plan is not None:
+            fault_plan.set_rates(
+                error_rate=phase.error_rate, delay_rate=phase.delay_rate
+            )
+        if phase.crash_node is not None:
+            crash_plans[phase.crash_node].arm(
+                phase.crash_after_ops, action=phase.crash_action
+            )
+        active = None
+        if phase.withhold_fraction:
+            withheld = set(
+                prng.sample(
+                    range(plan.validator_count),
+                    int(phase.withhold_fraction * plan.validator_count),
+                )
+            )
+            active = set(range(plan.validator_count)) - withheld
+        for s_i in range(phase.slots):
+            storm_ready = slot > 2
+            sim.run_slot(
+                slot,
+                active_validators=active,
+                equivocate=bool(
+                    storm_ready
+                    and phase.equivocate_every
+                    and s_i % phase.equivocate_every == 0
+                ),
+                forge=bool(
+                    storm_ready
+                    and phase.forge_every
+                    and s_i % phase.forge_every == 0
+                ),
+            )
+            if (
+                storm_ready
+                and phase.conflicting_atts_every
+                and s_i % phase.conflicting_atts_every == 0
+            ):
+                sim.publish_conflicting_attestations(slot)
+                sim.drain()
+            # mid-scenario crash-recovery: any node whose store killed it
+            # (not an intentional leave) reopens through WAL recovery,
+            # must be fsck-clean (freezer decodability included), then
+            # re-syncs and rejoins the slot loop
+            for n in list(sim.dead):
+                if n.peer_id in left_peers:
+                    continue
+                reopened = sim.reopen_node(n)
+                checker.note_restart(reopened)
+                issues = [str(i) for i in run_fsck(reopened.chain.store)]
+                crash_recoveries.append(
+                    {
+                        "peer": reopened.peer_id,
+                        "slot": slot,
+                        "journal_recovery":
+                            reopened.chain.store.journal_recovery,
+                        "fsck_issues": issues,
+                    }
+                )
+                if issues:
+                    raise InvariantViolation(
+                        f"reopened {reopened.peer_id} is not fsck-clean: "
+                        f"{issues}"
+                    )
+                reopened.range_sync()
+                sim.drain()
+            checker.check_slot(slot)
+            slot += 1
+
+    # final settle: heal anything still split, sync stragglers
+    sim.heal()
+    sim.sync_all()
+    checker.check_slot(slot)
+
+    # -- SLO evaluation (metrics deltas + trace-derived health) --------------
+    from ..utils.monitoring import trace_health_fields
+
+    finalized = max(
+        int(n.chain.finalized_checkpoint[0]) for n in sim.nodes
+    )
+    heads = sorted({n.chain.head_root.hex() for n in sim.nodes})
+    deltas = {
+        k: v - base_counts[k] for k, v in _counter_snapshot().items()
+    }
+    observed_p95 = M.BLOCK_OBSERVED_DELAY.quantile(0.95, since=observed_base)
+    imported_p95 = M.BLOCK_IMPORTED_DELAY.quantile(0.95, since=imported_base)
+    slashings = sum(
+        n.slasher_service.proposer_slashings_found
+        for n in sim.nodes
+        if n.slasher_service is not None
+    )
+    fsck_issues: dict[str, list[str]] = {}
+    if plan.slo.fsck_clean:
+        for n in sim.nodes:
+            issues = [str(i) for i in run_fsck(n.chain.store)]
+            if issues:
+                fsck_issues[n.peer_id] = issues
+
+    slo = plan.slo
+    failures: list[str] = []
+    if slo.heads_converge and len(heads) != 1:
+        failures.append(f"heads diverged at scenario end: {len(heads)}")
+    if finalized < slo.finality_min_epoch:
+        failures.append(
+            f"finalized epoch {finalized} < floor {slo.finality_min_epoch}"
+        )
+    if (
+        slo.observed_delay_p95_s is not None
+        and observed_p95 is not None
+        and observed_p95 > slo.observed_delay_p95_s
+    ):
+        failures.append(
+            f"observed-delay p95 {observed_p95} > {slo.observed_delay_p95_s}"
+        )
+    if (
+        slo.imported_delay_p95_s is not None
+        and imported_p95 is not None
+        and imported_p95 > slo.imported_delay_p95_s
+    ):
+        failures.append(
+            f"imported-delay p95 {imported_p95} > {slo.imported_delay_p95_s}"
+        )
+    for key, bound in (
+        ("retry_attempts", slo.max_retry_attempts),
+        ("breaker_transitions", slo.max_breaker_transitions),
+        ("bisection_calls", slo.max_bisection_calls),
+    ):
+        if bound is not None and deltas[key] > bound:
+            failures.append(f"{key} {deltas[key]} > budget {bound}")
+    if slo.expect_proposer_slashings and slashings == 0:
+        failures.append("no proposer slashing detected during the storm")
+    if fsck_issues:
+        failures.append(f"fsck issues: {fsck_issues}")
+
+    trace = tracer.dump_json()
+    report = {
+        "name": plan.name,
+        "seed": plan.seed,
+        "nodes": len(sim.nodes),
+        "validators": plan.validator_count,
+        "slots_run": slot,
+        "final_heads": heads,
+        "finalized_epoch": finalized,
+        "invariants": {"checked_slots": checker.checked_slots},
+        "crash_recoveries": crash_recoveries,
+        "proposer_slashings_found": slashings,
+        "byzantine_blocks_gossiped": len(sim.forged_roots)
+        + len(sim.equivocation_roots),
+        "slo": {
+            "observed_delay_p95_s": observed_p95,
+            "imported_delay_p95_s": imported_p95,
+            "counter_deltas": deltas,
+            "health": trace_health_fields(),
+            "failures": failures,
+        },
+        "fsck_issues": fsck_issues,
+        "trace_events": len(tracer.finished_spans()),
+        "trace_sha256": hashlib.sha256(trace.encode()).hexdigest(),
+    }
+    return ScenarioResult(report=report, trace=trace)
+
+
+def _partition_by_sim_index(sim, groups) -> None:
+    by_index = {
+        getattr(n, "sim_index", i): n for i, n in enumerate(sim.nodes)
+    }
+    sim._partition = [
+        [by_index[i] for i in g if i in by_index] for g in groups
+    ]
+    sim._partition = [g for g in sim._partition if g]
+    sim.raw_bus.set_partitions(
+        [[n.peer_id for n in g] for g in sim._partition]
+    )
+
+
+def assert_bit_identical_replay(plan: ScenarioPlan):
+    """Run the plan twice; the two runs must agree on final heads AND
+    export byte-identical traces (the bit-replay contract)."""
+    r1 = run_scenario(plan)
+    r2 = run_scenario(plan)
+    assert r1.report["final_heads"] == r2.report["final_heads"], (
+        "replay diverged: final heads differ"
+    )
+    assert r1.trace == r2.trace, "replay diverged: trace bytes differ"
+    return r1, r2
+
+
+# -- the scenario catalogue (cli `scenario --name ...` + the test matrix) ----
+
+
+def _spe() -> int:
+    return MINIMAL.slots_per_epoch
+
+
+def partition_plan(seed=0, nodes=4, validators=64) -> ScenarioPlan:
+    """Split the network 50/50 for ~an epoch, heal, require finality."""
+    spe = _spe()
+    return ScenarioPlan(
+        name="partition",
+        seed=seed,
+        node_count=nodes,
+        validator_count=validators,
+        phases=(
+            Phase("baseline", slots=spe),
+            Phase(
+                "split",
+                slots=spe,
+                partition=(
+                    tuple(range(nodes // 2)),
+                    tuple(range(nodes // 2, nodes)),
+                ),
+            ),
+            Phase("heal", slots=3 * spe, heal=True),
+        ),
+        slo=SLO(
+            finality_min_epoch=2,
+            observed_delay_p95_s=6.0,
+            max_retry_attempts=100,
+            max_breaker_transitions=50,
+            max_bisection_calls=100,
+        ),
+    )
+
+
+def churn_plan(seed=0, nodes=4, validators=64) -> ScenarioPlan:
+    """Nodes leave and fresh nodes join mid-run; leavers rejoin and
+    everyone converges with sync catch-up."""
+    spe = _spe()
+    return ScenarioPlan(
+        name="churn",
+        seed=seed,
+        node_count=nodes,
+        validator_count=validators,
+        phases=(
+            Phase("baseline", slots=spe),
+            Phase("churn", slots=spe, join_nodes=2, leave_nodes=(nodes - 1,)),
+            Phase("rejoin", slots=2 * spe, rejoin_nodes=(nodes - 1,)),
+        ),
+        slo=SLO(
+            finality_min_epoch=2,
+            observed_delay_p95_s=6.0,
+            max_retry_attempts=100,
+            max_breaker_transitions=50,
+            max_bisection_calls=100,
+        ),
+    )
+
+
+def equivocation_storm_plan(seed=0, nodes=4, validators=64) -> ScenarioPlan:
+    """A Byzantine fraction double-proposes, forges invalid blocks, and
+    double-votes; honest nodes must ignore/reject every artifact, keep
+    finalizing, and the slashers must detect the proposer equivocation."""
+    spe = _spe()
+    return ScenarioPlan(
+        name="equivocation-storm",
+        seed=seed,
+        node_count=nodes,
+        validator_count=validators,
+        attach_slashers=True,
+        phases=(
+            Phase("baseline", slots=spe),
+            Phase(
+                "storm",
+                slots=2 * spe,
+                equivocate_every=2,
+                forge_every=4,
+                conflicting_atts_every=4,
+            ),
+            Phase("recovery", slots=2 * spe),
+        ),
+        slo=SLO(
+            finality_min_epoch=3,
+            expect_proposer_slashings=True,
+            observed_delay_p95_s=6.0,
+            max_retry_attempts=100,
+            max_breaker_transitions=50,
+            max_bisection_calls=100,
+        ),
+    )
+
+
+def long_nonfinality_plan(seed=0, nodes=4, validators=64) -> ScenarioPlan:
+    """Withhold >1/3 of validators for multiple epochs (justification
+    stalls, the hot DB grows), then recover: the finality jump drives the
+    sub-batched migrate_to_freezer over a multi-epoch range, and every
+    store must end fsck-clean including freezer decodability."""
+    spe = _spe()
+    return ScenarioPlan(
+        name="long-nonfinality",
+        seed=seed,
+        node_count=nodes,
+        validator_count=validators,
+        # deliberately tiny windows: the multi-epoch finality jump MUST
+        # commit through several journaled sub-batches
+        migration_chunk_slots=spe,
+        phases=(
+            Phase("baseline", slots=spe),
+            Phase("stall", slots=3 * spe, withhold_fraction=0.4),
+            Phase("recovery", slots=4 * spe),
+        ),
+        slo=SLO(
+            finality_min_epoch=5,
+            observed_delay_p95_s=6.0,
+            max_retry_attempts=100,
+            max_breaker_transitions=50,
+            max_bisection_calls=100,
+        ),
+    )
+
+
+def crash_recovery_plan(seed=0, nodes=4, validators=64) -> ScenarioPlan:
+    """CrashPlan kills a node at the Nth store op mid-scenario; it
+    reopens through WAL recovery, passes fsck (freezer decodability
+    included), re-syncs, and the network converges."""
+    spe = _spe()
+    return ScenarioPlan(
+        name="crash-recovery",
+        seed=seed,
+        node_count=nodes,
+        validator_count=validators,
+        phases=(
+            Phase("baseline", slots=spe),
+            Phase(
+                "crash",
+                slots=2 * spe,
+                crash_node=1,
+                # tuned to land mid-batch so the reopen exercises a real
+                # WAL replay, not a clean batch-boundary restart
+                crash_after_ops=23,
+                crash_action="after",
+            ),
+            Phase("settle", slots=2 * spe),
+        ),
+        slo=SLO(
+            finality_min_epoch=3,
+            observed_delay_p95_s=6.0,
+            max_retry_attempts=100,
+            max_breaker_transitions=50,
+            max_bisection_calls=100,
+        ),
+    )
+
+
+PLANS = {
+    "partition": partition_plan,
+    "churn": churn_plan,
+    "equivocation-storm": equivocation_storm_plan,
+    "long-nonfinality": long_nonfinality_plan,
+    "crash-recovery": crash_recovery_plan,
+}

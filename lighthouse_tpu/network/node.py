@@ -230,10 +230,14 @@ class NetworkNode:
         if self.is_banned(source):
             return
         block = signed_block.message
-        verdict = self.observed_block_producers.observe(
-            block.slot, block.proposer_index, block.tree_hash_root()
+        # read-only exact-duplicate shedding against VERIFIED sightings;
+        # recording happens post-signature-verification in the worker
+        # (process_gossip_block), so an unverified forged block can never
+        # suppress the real proposal (observe-after-verification pattern)
+        known = self.observed_block_producers.known_root(
+            block.slot, block.proposer_index
         )
-        if verdict == "duplicate":
+        if known is not None and known == block.tree_hash_root():
             return
         # the trace's first event + the slot-relative observation delay
         # (reference beacon_block_delay_gossip): both ride injected clocks
@@ -489,20 +493,44 @@ class NetworkNode:
         signed_block, source = item
         from ..chain.block_verification import (
             BlockAlreadyKnown,
+            BlockEquivocation,
             UnknownParent,
             process_gossip_block,
         )
 
         try:
-            process_gossip_block(self.chain, signed_block)
+            process_gossip_block(
+                self.chain, signed_block, self.observed_block_producers
+            )
         except BlockAlreadyKnown:
             return  # benign gossip/sync overlap: never penalized
+        except BlockEquivocation:
+            # a SIGNATURE-VALID second distinct block from the same
+            # (slot, proposer): spec gossip validation IGNOREs it (no
+            # penalty — the relayer may be honest), and it must not enter
+            # fork choice through gossip. The slasher sees the verified
+            # header: two conflicting headers from one proposer are
+            # exactly a ProposerSlashing detection (the
+            # equivocation-storm scenario's safety invariant).
+            M.BLOCK_EQUIVOCATIONS.inc()
+            if self.slasher_service is not None:
+                self.slasher_service.accept_block(signed_block)
+            return
         except UnknownParent as e:
             # chase the ANCESTRY we're missing (block_lookups/), then
             # import the block we already hold -- no refetch of it
             if self.sync_manager.lookup_block(e.parent_root):
                 try:
-                    process_gossip_block(self.chain, signed_block)
+                    process_gossip_block(
+                        self.chain,
+                        signed_block,
+                        self.observed_block_producers,
+                    )
+                except BlockEquivocation:
+                    M.BLOCK_EQUIVOCATIONS.inc()
+                    if self.slasher_service is not None:
+                        self.slasher_service.accept_block(signed_block)
+                    return
                 except BlockError:
                     self.penalize(source)
                     return
@@ -632,6 +660,13 @@ class NetworkNode:
     # -- publish (the local node's own messages) ----------------------------
 
     def publish_block(self, signed_block) -> None:
+        # record our OWN proposal in the equivocation filter: without
+        # this, a Byzantine double-proposal gossiped back at the
+        # proposer's node would count as a first sighting and import
+        block = signed_block.message
+        self.observed_block_producers.observe(
+            block.slot, block.proposer_index, block.tree_hash_root()
+        )
         self.chain.process_block(signed_block)
         if self.slasher_service is not None:
             self.slasher_service.accept_block(signed_block)

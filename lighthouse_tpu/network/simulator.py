@@ -1,14 +1,23 @@
 """Multi-node in-process simulator (reference testing/simulator/src/main.rs
 + checks.rs + node_test_rig: N beacon nodes + validator shares on one
-runtime, liveness/finality invariants asserted as slots progress)."""
+runtime, liveness/finality invariants asserted as slots progress).
+
+Grown into the scenario harness's substrate (harness/scenario.py): the
+bus supports transport-level partitions, nodes join/leave/crash/reopen
+mid-run, validators are HOMED on nodes (a partitioned or offline node's
+validators stop proposing and attesting — the realistic stake split),
+and block production is per-partition-group so each side of a split
+extends its own fork. Everything stays deterministic: same seed, same
+schedule, same heads, bit-identical trace export."""
 
 from __future__ import annotations
 
-from ..harness.chain import StateHarness
 from ..chain.beacon_chain import BeaconChain
+from ..harness.chain import StateHarness
+from ..resilience.crash import CrashingStore, InjectedCrash
 from ..store.hot_cold import HotColdDB
 from ..store.kv import MemoryStore
-from ..types import ChainSpec, compute_epoch_at_slot, interop_genesis_state
+from ..types import ChainSpec
 from ..types.presets import Preset
 from .message_bus import MessageBus
 from .node import NetworkNode
@@ -22,10 +31,14 @@ class Simulator:
         preset: Preset,
         spec: ChainSpec | None = None,
         fault_plan=None,
+        crash_plans: dict | None = None,
+        attach_slashers: bool = False,
+        migration_chunk_slots: int | None = None,
     ):
         self.preset = preset
         self.spec = spec or ChainSpec.interop()
-        self.bus = MessageBus()
+        self.raw_bus = MessageBus()
+        self.bus = self.raw_bus
         self.fault_plan = fault_plan
         if fault_plan is not None:
             # chaos mode: every node talks to the bus through the seeded
@@ -34,53 +47,346 @@ class Simulator:
             # retry/penalty paths run for real instead of only on
             # hand-scripted broken handlers. Only `request` is faulted:
             # req/resp is where the retry machinery lives.
-            self.bus = fault_plan.wrap(self.bus, "bus", methods=("request",))
+            self.bus = fault_plan.wrap(self.raw_bus, "bus", methods=("request",))
         self.producer = StateHarness(
             validator_count, preset, self.spec, sign=False
         )
-        genesis = self.producer.state
-        self.nodes: list[NetworkNode] = []
-        for i in range(node_count):
-            from ..state_transition import clone_state
-
-            store = HotColdDB(MemoryStore(), preset, self.spec)
-            chain = BeaconChain(store, clone_state(genesis), preset, self.spec)
-            self.nodes.append(NetworkNode(f"node{i}", chain, self.bus))
-        # validator shares: validator v is driven through node v % N
+        self.genesis = self.producer.state
         self.validator_count = validator_count
+        self.attach_slashers = attach_slashers
+        self.migration_chunk_slots = migration_chunk_slots
+        # seeded per-node crash schedules: node index -> CrashPlan; the
+        # node's kv routes every mutation through CrashingStore so an
+        # armed plan kills "the process" at exactly the Nth store op
+        self.crash_plans = dict(crash_plans or {})
+        self.nodes: list[NetworkNode] = []
+        self.dead: list[NetworkNode] = []
+        self._next_index = 0
+        # storm artifacts the invariant checker audits: roots that must
+        # NEVER be imported by an honest node via gossip
+        self.equivocation_roots: list[bytes] = []
+        self.forged_roots: list[bytes] = []
+        # current split as node groups (None = fully connected)
+        self._partition: list[list[NetworkNode]] | None = None
+        for _ in range(node_count):
+            self.add_node()
+        # validator shares: validator v is HOMED on node v % N (it
+        # proposes/attests only while that node is alive and connected)
+        self.validator_home = {
+            v: self.nodes[v % node_count].peer_id
+            for v in range(validator_count)
+        }
+
+    # -- node lifecycle (churn / crash-recovery) -----------------------------
+
+    def add_node(self, peer_id: str | None = None) -> NetworkNode:
+        """A fresh node from genesis joining the bus (churn join: it must
+        range-sync to catch up). Homed validators are only assigned at
+        construction — later joiners carry no stake, like a new peer."""
+        from ..state_transition import clone_state
+
+        index = self._next_index
+        self._next_index += 1
+        kv = MemoryStore()
+        plan = self.crash_plans.get(index)
+        if plan is not None:
+            kv = CrashingStore(kv, plan)
+        store = HotColdDB(
+            kv,
+            self.preset,
+            self.spec,
+            migration_chunk_slots=self.migration_chunk_slots,
+        )
+        chain = BeaconChain(
+            store, clone_state(self.genesis), self.preset, self.spec
+        )
+        node = NetworkNode(peer_id or f"node{index}", chain, self.bus)
+        node.sim_index = index
+        if self.attach_slashers:
+            from ..slasher import Slasher
+
+            node.attach_slasher(
+                Slasher.open(MemoryStore(), self.preset, self.spec)
+            )
+        self.nodes.append(node)
+        return node
+
+    def remove_node(self, node: NetworkNode) -> None:
+        """Peer leave: drop every subscription and rpc registration; its
+        homed validators go silent until it rejoins."""
+        self.raw_bus.disconnect(node.peer_id)
+        if node in self.nodes:
+            self.nodes.remove(node)
+        self.dead.append(node)
+
+    def rejoin_node(self, node: NetworkNode) -> NetworkNode:
+        """A previously-removed node rejoins with its existing chain
+        (fresh NetworkNode so all subscriptions re-register); it must
+        range-sync to catch up. The old node's slasher (with its
+        accumulated detection history) rides along."""
+        fresh = NetworkNode(node.peer_id, node.chain, self.bus)
+        fresh.sim_index = getattr(node, "sim_index", -1)
+        if node.slasher_service is not None:
+            fresh.attach_slasher(node.slasher_service.slasher)
+        elif self.attach_slashers:
+            from ..slasher import Slasher
+
+            fresh.attach_slasher(
+                Slasher.open(MemoryStore(), self.preset, self.spec)
+            )
+        if node in self.dead:
+            self.dead.remove(node)
+        self.nodes.append(fresh)
+        self._replace_in_partition(node, fresh)
+        return fresh
+
+    def mark_dead(self, node: NetworkNode) -> None:
+        """A node's simulated process died (InjectedCrash): it vanishes
+        from the network mid-flight; reopen_node resurrects it."""
+        self.remove_node(node)
+
+    def _replace_in_partition(self, old: NetworkNode, new: NetworkNode) -> None:
+        """A reopened/rejoined node takes the old object's seat in any
+        installed split (group membership is by node object and, on the
+        bus, by peer id — disconnect dropped both): partition and
+        crash/churn knobs must compose, not silently isolate the node."""
+        if self._partition is None:
+            return
+        for group in self._partition:
+            if old in group:
+                group[group.index(old)] = new
+        self.raw_bus.set_partitions(
+            [[n.peer_id for n in g] for g in self._partition]
+        )
+
+    def reopen_node(self, node: NetworkNode) -> NetworkNode:
+        """Simulated process restart after a crash: reopen the dead
+        node's kv the way a restarted process would (HotColdDB open runs
+        write-ahead-journal recovery), resume FromStore, rejoin the bus
+        under the same peer id. The caller range-syncs it afterwards.
+        The CrashingStore wrapper (with its spent plan) is KEPT around
+        the reopened store: re-arming the plan in a later phase models a
+        node that dies again."""
+        kv = node.chain.store.kv
+        if isinstance(kv, CrashingStore):
+            # the spent plan passes everything through until re-armed;
+            # recovery's own writes therefore never re-crash
+            kv = CrashingStore(kv.inner, kv.plan)
+        store = HotColdDB(
+            kv,
+            self.preset,
+            self.spec,
+            migration_chunk_slots=self.migration_chunk_slots,
+        )
+        chain = BeaconChain.from_store(store, self.preset, self.spec)
+        fresh = NetworkNode(node.peer_id, chain, self.bus)
+        fresh.sim_index = getattr(node, "sim_index", -1)
+        if self.attach_slashers:
+            from ..slasher import Slasher
+
+            fresh.attach_slasher(
+                Slasher.open(MemoryStore(), self.preset, self.spec)
+            )
+        if node in self.dead:
+            self.dead.remove(node)
+        self.nodes.append(fresh)
+        self._replace_in_partition(node, fresh)
+        return fresh
+
+    # -- partitions ----------------------------------------------------------
+
+    def partition(self, groups) -> None:
+        """Split the bus: `groups` is a list of node-index lists. Nodes in
+        different groups cannot gossip or req/resp each other until
+        heal(). Production becomes per-group: each side extends its own
+        fork with its own homed validators."""
+        node_groups = [[self.nodes[i] for i in g] for g in groups]
+        self._partition = node_groups
+        self.raw_bus.set_partitions(
+            [[n.peer_id for n in g] for g in node_groups]
+        )
+
+    def heal(self) -> None:
+        self._partition = None
+        self.raw_bus.heal()
+
+    def _node_groups(self) -> list[list[NetworkNode]]:
+        if self._partition is None:
+            return [list(self.nodes)] if self.nodes else []
+        # drop nodes that died/left since the split was installed
+        groups = [
+            [n for n in g if n in self.nodes] for g in self._partition
+        ]
+        return [g for g in groups if g]
+
+    def _group_validators(self, group) -> set[int]:
+        peers = {n.peer_id for n in group}
+        return {
+            v for v, home in self.validator_home.items() if home in peers
+        }
+
+    # -- slot driving --------------------------------------------------------
 
     def tick(self, slot: int) -> None:
-        for n in self.nodes:
+        for n in list(self.nodes):
             n.chain.slot_clock.set_slot(slot)
-            n.chain.on_tick()
-            n.on_slot()  # slasher batch + other per-slot services
+            try:
+                n.chain.on_tick()
+                n.on_slot()  # slasher batch + other per-slot services
+            except InjectedCrash:
+                self.mark_dead(n)
 
-    def run_slot(self, slot: int, attest: bool = True) -> None:
-        """One slot of the synthetic network: the proposer's node produces
-        and gossips a block; every node's processor drains; attestations
-        for the previous slot ride the subnets."""
+    def run_slot(
+        self,
+        slot: int,
+        attest: bool = True,
+        active_validators=None,
+        equivocate: bool = False,
+        forge: bool = False,
+    ) -> None:
+        """One slot of the synthetic network, per partition group: the
+        group holding the proposer's home node produces and gossips a
+        block carrying the group's attestations for the previous slot;
+        every node's processor drains. `active_validators` restricts who
+        proposes/attests (long-non-finality withholding); `equivocate`
+        gossips a second conflicting proposal and `forge` an invalid one
+        (equivocation-storm phases), both relayed by a synthetic
+        Byzantine peer that is not a real node."""
         self.tick(slot)
-        proposer_node = self.nodes[slot % len(self.nodes)]
-        parent_state = proposer_node.chain._states[
-            proposer_node.chain.head_root
-        ]
+        for group in self._node_groups():
+            self._produce_for_group(
+                group, slot, attest, active_validators, equivocate, forge
+            )
+        self.drain()
+
+    def _produce_for_group(
+        self, group, slot, attest, active_validators, equivocate, forge
+    ) -> None:
+        from ..state_transition import (
+            clone_state,
+            get_beacon_proposer_index,
+            process_slots,
+        )
+
+        leader = group[0]
+        parent_state = leader.chain._states[leader.chain.head_root]
+        adv = process_slots(
+            clone_state(parent_state), slot, self.preset, self.spec
+        )
+        proposer = get_beacon_proposer_index(adv, self.preset, self.spec)
+        allowed = self._group_validators(group)
+        if active_validators is not None:
+            allowed &= set(active_validators)
+        if proposer not in allowed:
+            return  # the proposer is on the other side / offline: empty slot
+        home = next(
+            (
+                n
+                for n in group
+                if n.peer_id == self.validator_home.get(proposer)
+            ),
+            leader,
+        )
+        if leader.chain.head_root not in home.chain._states:
+            # the proposer's home has not reconciled the group's head yet
+            # (fresh heal/rejoin): the leader publishes on its behalf
+            home = leader
         atts = []
         if attest and slot > 1:
-            from ..state_transition import clone_state, process_slots
-
-            adv = process_slots(
-                clone_state(parent_state), slot, self.preset, self.spec
+            atts = self.producer.attestations_for_slot(
+                adv, slot - 1, validators=allowed
             )
-            atts = self.producer.attestations_for_slot(adv, slot - 1)
         signed, _ = self.producer.produce_block(
             slot, atts, base_state=parent_state
         )
-        proposer_node.publish_block(signed)
-        self.drain()
+        try:
+            home.publish_block(signed)
+        except InjectedCrash:
+            self.mark_dead(home)
+            return
+        if equivocate or forge:
+            # the Byzantine injector must sit on THIS group's side of any
+            # installed split, or its gossip would reach nobody and the
+            # storm invariants would pass vacuously
+            self.raw_bus.join_group("byz", home.peer_id)
+        if equivocate:
+            # a SECOND distinct proposal by the same (slot, proposer):
+            # honest nodes must IGNORE it (never import via gossip) and
+            # their slashers must detect the double proposal
+            double, _ = self.producer.produce_block(
+                slot, atts, base_state=parent_state, graffiti=b"equivocation"
+            )
+            self.equivocation_roots.append(double.message.tree_hash_root())
+            self.raw_bus.publish("byz", home._topic_block, double)
+        if forge:
+            # a provably-invalid block (wrong proposer + garbage state
+            # root — a distinct proposer so the equivocation dedup does
+            # not mask the invalidity path): honest nodes must reject it
+            # AND penalize the Byzantine relayer
+            bad, _ = self.producer.produce_block(
+                slot, base_state=parent_state, graffiti=b"forged"
+            )
+            bad.message.proposer_index = (
+                int(proposer) + 1
+            ) % self.validator_count
+            bad.message.state_root = b"\x66" * 32
+            self.forged_roots.append(bad.message.tree_hash_root())
+            self.raw_bus.publish("byz", home._topic_block, bad)
 
-    def drain(self) -> None:
-        for n in self.nodes:
-            n.processor.run_until_idle()
+    def publish_conflicting_attestations(self, slot: int) -> None:
+        """A Byzantine double vote: two attestations from the same
+        committee seat for the same slot naming DIFFERENT head blocks,
+        both relayed on the subnet. Dedup (ObservedAttesters) must keep
+        fork choice single-voted; the network must keep finalizing."""
+        from ..state_transition import clone_state, process_slots
+        from ..types.containers import AttestationData, Checkpoint
+        from ..types import types_for
+        from .message_bus import topic_name
+
+        if not self.nodes:
+            return
+        leader = self.nodes[0]
+        head = leader.chain.head_state
+        adv = process_slots(
+            clone_state(head), slot, self.preset, self.spec
+        )
+        att = self.producer.make_unaggregated(adv, slot - 1, 0, 0)
+        d = att.data
+        conflicting = types_for(self.preset).Attestation(
+            aggregation_bits=att.aggregation_bits,
+            data=AttestationData(
+                slot=d.slot,
+                index=d.index,
+                beacon_block_root=leader.chain.genesis_block_root,
+                source=Checkpoint(
+                    epoch=d.source.epoch, root=bytes(d.source.root)
+                ),
+                target=Checkpoint(
+                    epoch=d.target.epoch, root=bytes(d.target.root)
+                ),
+            ),
+            signature=att.signature,
+        )
+        topic = topic_name(
+            "beacon_attestation", leader.fork_digest, 0
+        )
+        self.raw_bus.join_group("byz", leader.peer_id)
+        self.raw_bus.publish("byz", topic, att)
+        self.raw_bus.publish("byz", topic, conflicting)
+
+    def drain(self) -> list[NetworkNode]:
+        """Drain every node's processor; a node whose store kills the
+        "process" mid-import (InjectedCrash) drops off the bus and is
+        returned for the scenario runner to reopen."""
+        crashed = []
+        for n in list(self.nodes):
+            try:
+                n.processor.run_until_idle()
+            except InjectedCrash:
+                crashed.append(n)
+        for n in crashed:
+            self.mark_dead(n)
+        return crashed
 
     def run_epochs(self, epochs: int, attest: bool = True) -> None:
         start = (
@@ -88,6 +394,46 @@ class Simulator:
         )
         for slot in range(start, start + epochs * self.preset.slots_per_epoch):
             self.run_slot(slot, attest=attest)
+
+    def sync_all(self) -> int:
+        """Every node range-syncs from its best peers AND reconciles
+        peer forks (post-heal / post-churn catch-up): range sync only
+        pulls from peers strictly AHEAD, so two equal-height forks left
+        by a partition are exchanged via unknown-head block lookups (the
+        reference's block_lookups path). Fork choice then converges every
+        node onto the heavier fork. Returns total imported blocks."""
+        from .node import STATUS_PROTOCOL
+
+        imported = 0
+        # fork reconciliation FIRST: equal-height forks are invisible to
+        # range sync's strictly-ahead ranking, and a range batch from the
+        # other fork without its ancestors would burn retry budget
+        for n in list(self.nodes):
+            try:
+                for peer in self.raw_bus.peers_on(n._topic_block):
+                    if peer == n.peer_id:
+                        continue
+                    try:
+                        status = self.bus.request(
+                            n.peer_id, peer, STATUS_PROTOCOL, {}
+                        )
+                        head = bytes(status["head_root"])
+                        if head not in n.chain._states:
+                            n.sync_manager.lookup_block(head)
+                    except (ConnectionError, OSError):
+                        # unreachable/faulted peer: reconcile the REST —
+                        # one dead peer must not abort the whole round
+                        continue
+                n.chain.recompute_head()
+            except InjectedCrash:
+                self.mark_dead(n)
+        for n in list(self.nodes):
+            try:
+                imported += n.range_sync()
+            except InjectedCrash:
+                self.mark_dead(n)
+        self.drain()
+        return imported
 
     # -- checks (testing/simulator/src/checks.rs) ---------------------------
 

@@ -153,6 +153,20 @@ class SyncManager:
                 got, progressed = self._import_batch(blocks)
                 imported += got
                 if not progressed:
+                    # the batch may be an honest peer's FORK: its blocks
+                    # descend from an ancestor we don't hold (a healed
+                    # partition's other side). Chase the missing parent
+                    # chain by root first (block_lookups) and retry; only
+                    # a batch that STILL doesn't apply is penalized —
+                    # banning honest fork-peers here is a liveness bug
+                    # (the heal would never converge).
+                    first_parent = bytes(blocks[0].message.parent_root)
+                    if first_parent not in chain._states and self.lookup_block(
+                        first_parent
+                    ):
+                        got, progressed = self._import_batch(blocks)
+                        imported += got
+                if not progressed:
                     # peer served a batch we can't use (bad chain / gap):
                     # penalize and re-rank — repeated offenders get banned
                     self.node.penalize(peer)

@@ -68,7 +68,10 @@ class ObservedBlockProducers:
         self._slots: dict[int, dict[int, bytes]] = {}
 
     def observe(self, slot: int, proposer: int, block_root: bytes):
-        """Returns 'duplicate' | 'equivocation' | None (first sighting)."""
+        """Returns 'duplicate' | 'equivocation' | None (first sighting).
+        Callers must only RECORD verified blocks (observe after the
+        proposer signature checks out): recording an unverified first
+        sighting would let a forged block suppress the real proposal."""
         by_proposer = self._slots.setdefault(slot, {})
         prev = by_proposer.get(proposer)
         if prev is not None:
@@ -78,6 +81,12 @@ class ObservedBlockProducers:
         for s in [s for s in self._slots if s < low]:
             del self._slots[s]
         return None
+
+    def known_root(self, slot: int, proposer: int) -> bytes | None:
+        """Read-only probe: the VERIFIED root already recorded for
+        (slot, proposer), or None. The gossip ingress uses it for cheap
+        exact-duplicate shedding without recording anything."""
+        return self._slots.get(slot, {}).get(proposer)
 
 
 class ObservedOperations:

@@ -193,7 +193,14 @@ class OperationPool:
             out += _s.pack(">I", len(items))
             for blob in items:
                 out += _s.pack(">I", len(blob)) + blob
-        store.put_chain_item(self._PERSIST_KEY, bytes(out))
+        # the blob rewrite commits through the write-ahead journal: a
+        # crash mid-write must leave the OLD blob or the NEW one, never a
+        # torn prefix (load() tolerates truncation, but best-effort decode
+        # of a torn blob silently drops operations; the journal's intent
+        # record makes the rewrite all-or-nothing on every backend)
+        batch = store.batch()
+        batch.stage_chain_item(self._PERSIST_KEY, bytes(out))
+        batch.commit()
 
     @classmethod
     def load(cls, store, preset: Preset, spec, log=None) -> "OperationPool":

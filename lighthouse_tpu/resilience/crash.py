@@ -69,6 +69,18 @@ class CrashPlan:
         self.ops = 0
         self.crashed = False
 
+    def arm(self, ops_from_now: int, action: str | None = None) -> "CrashPlan":
+        """Phase-scoped (re-)arming: schedule the next death
+        `ops_from_now` intercepted mutations from NOW. The scenario
+        harness composes one plan across phases (arm it when the
+        crash-recovery phase starts) instead of wrapping a fresh store
+        mid-run; re-arming after a death models a node that dies again."""
+        self.crash_at = self.ops + int(ops_from_now)
+        if action is not None:
+            self.action = action
+        self.crashed = False
+        return self
+
     def decide(self, op: str) -> str:
         index = self.ops
         self.ops += 1

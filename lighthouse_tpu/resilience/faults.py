@@ -86,6 +86,23 @@ class FaultPlan:
     def fail_next(self, op: str, n: int = 1) -> "FaultPlan":
         return self.script(op, [ERROR] * n)
 
+    def set_rates(
+        self,
+        error_rate: float = 0.0,
+        delay_rate: float = 0.0,
+        hang_rate: float = 0.0,
+    ) -> "FaultPlan":
+        """Phase-scoped rate swap (the scenario harness composes ONE plan
+        across adversarial phases instead of re-wrapping transports
+        mid-run). Determinism is preserved across phases: every
+        intercepted call draws from the seeded rng exactly once whatever
+        the rates, so changing a phase's rates never shifts the schedule
+        of later phases."""
+        self.error_rate = float(error_rate)
+        self.delay_rate = float(delay_rate)
+        self.hang_rate = float(hang_rate)
+        return self
+
     def clear_scripts(self) -> None:
         """Drop all pending scripted decisions ("the outage ends"); the
         seeded rng keeps scheduling."""

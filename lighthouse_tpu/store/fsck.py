@@ -10,6 +10,9 @@ to preserve — the ones a torn multi-key mutation would break:
   contiguous over the frozen range (no holes below the split);
 * restore points exist at `slots_per_restore_point` stride below the
   `restore_points_to` high-water mark;
+* frozen blocks and restore-point states actually DECODE (not just key
+  contiguity): a torn or bit-rotted freezer row would otherwise surface
+  only when a historical replay trips over it;
 * the head pointer resolves: `head_block_root` has a post-state mapping,
   `head_state_root` matches it, and the state row (full or summary) is
   actually present;
@@ -121,6 +124,42 @@ def run_fsck(db) -> list[FsckIssue]:
                     f"slot {missing[0]}",
                 )
             )
+
+    # -- freezer decodability -----------------------------------------------
+    # key contiguity is not enough: a frozen row can exist and still be
+    # garbage (torn native-log tail, bit rot). Decode every frozen block
+    # and every restore-point state; the crash-recovery scenario runs this
+    # after every reopen.
+    bad_blocks = []
+    for root in kv.keys(Column.FREEZER_BLOCK):
+        try:
+            blk = db._decode_stored_block(kv.get(Column.FREEZER_BLOCK, root))
+            if bytes(blk.message.tree_hash_root()) != bytes(root):
+                raise ValueError("stored block does not match its key root")
+        except (ValueError, KeyError, IndexError, struct.error):
+            bad_blocks.append(bytes(root))
+    if bad_blocks:
+        issues.append(
+            FsckIssue(
+                "freezer-decode",
+                f"{len(bad_blocks)} frozen block(s) fail to decode, first "
+                f"{bad_blocks[0].hex()[:12]}",
+            )
+        )
+    bad_states = []
+    for key in kv.keys(Column.FREEZER_STATE):
+        try:
+            db.decode_stored_state(kv.get(Column.FREEZER_STATE, key))
+        except (ValueError, KeyError, IndexError, struct.error):
+            bad_states.append(struct.unpack(">Q", key)[0])
+    if bad_states:
+        issues.append(
+            FsckIssue(
+                "freezer-decode",
+                f"{len(bad_states)} restore-point state(s) fail to decode, "
+                f"first at slot {bad_states[0]}",
+            )
+        )
 
     # -- head pointer --------------------------------------------------------
     head = db.get_chain_item(b"head_block_root")

@@ -114,6 +114,7 @@ class HotColdDB:
         spec,
         slots_per_snapshot: int | None = None,
         slots_per_restore_point: int | None = None,
+        migration_chunk_slots: int | None = None,
     ):
         self.kv = kv
         self.preset = preset
@@ -127,6 +128,10 @@ class HotColdDB:
         self.slots_per_restore_point = (
             slots_per_restore_point or 4 * preset.slots_per_epoch
         )
+        # hot->cold migration commits in journaled sub-batches of this
+        # many slots (the long-non-finality memory bound: a multi-epoch
+        # finality jump must not stage the whole range in one batch)
+        self.migration_chunk_slots = migration_chunk_slots or 2 * CHUNK_SIZE
         # serializes multi-batch freezer mutations (migrate_to_freezer,
         # reconstruct_historic_states, prune_payloads) across threads:
         # kv.do_atomically makes each BATCH atomic, but the
@@ -212,14 +217,23 @@ class HotColdDB:
         if batch is None:
             sink.commit()
 
-    def get_full_state(self, state_root: bytes):
-        data = self.kv.get(Column.STATE, state_root)
-        if data is None:
-            return None
+    def decode_stored_state(self, data: bytes):
+        """Decode a stored full-state payload (b"F" + fork + NUL + ssz):
+        the ONE place the framing is interpreted — hot snapshots, frozen
+        restore points, and fsck's decodability walk all read through it.
+        Raises ValueError-family errors on a torn/corrupt payload."""
+        if not data or data[:1] != b"F":
+            raise ValueError("not a full-state payload")
         fork, _, body = data[1:].partition(b"\x00")
         t = types_for(self.preset)
         cls = state_class_for(t, fork.decode())
         return cls.from_ssz_bytes(body)
+
+    def get_full_state(self, state_root: bytes):
+        data = self.kv.get(Column.STATE, state_root)
+        if data is None:
+            return None
+        return self.decode_stored_state(data)
 
     def get_state(self, state_root: bytes, blocks_by_root=None):
         """Load a state, replaying blocks from the nearest stored snapshot
@@ -317,18 +331,28 @@ class HotColdDB:
         most one restore-point read + a bounded block replay
         (hot_cold_store.rs store_cold_state/load_cold_state).
 
-        The whole migration — freezer copies, hot prunes, chunked root
-        rows, restore points, the finalized-checkpoint pointer, and the
-        split-slot advance — commits as ONE atomic batch through the
-        write-ahead journal: a crash at any store op replays or rolls
-        back on reopen, so `split_slot` can never name freezer contents
-        that are not there (the torn state the reference's leveldb
-        write-batches rule out)."""
+        The migration commits through the write-ahead journal in bounded
+        SUB-BATCHES (the documented single-batch memory trade-off,
+        resolved): block copies + hot prunes + chunked block-root rows
+        per `migration_chunk_slots`-slot window in ascending slot order,
+        then the state-root rows, then one batch per missing restore
+        point, and FINALLY the split-slot advance (+ stride and
+        finalized-checkpoint pointers) as its own batch. Each sub-batch
+        is individually atomic, and the ordering keeps every inter-batch
+        crash point consistent: frozen content is only ever a superset of
+        what `split_slot` claims, a hot block is pruned only after its
+        freezer copy and root-row committed, and a re-run resumes
+        idempotently (moved blocks are no longer hot, existing chunk rows
+        win over recomputation, the restore-point sweep restarts from its
+        marker). Staged memory is bounded by one window of blocks or one
+        full state, never by the length of a non-finality stretch."""
         with self._mutation_lock:
             old_split = self.split_slot
-            batch = self.batch()
-            chunks = _ChunkWriter(self.kv)
-            migrated = []  # canonical (slot, root) for root derivation
+            # collect the hot KEYS to move/prune ONCE, sorted by slot;
+            # block payloads are re-read per window at staging time so
+            # peak memory really is one window, not the whole stretch
+            moves = []  # canonical: (slot, root) -> freezer
+            prunes = []  # non-canonical: (slot, root) -> delete only
             for root in list(self.kv.keys(Column.BLOCK)):
                 data = self.kv.get(Column.BLOCK, root)
                 if data is None:
@@ -336,59 +360,113 @@ class HotColdDB:
                 block = self.get_block(root)
                 if block.message.slot < finalized_slot:
                     if root in canonical_roots:
-                        batch.stage(Column.FREEZER_BLOCK, root, data)
-                        migrated.append(
+                        moves.append((int(block.message.slot), bytes(root)))
+                    else:
+                        prunes.append(
                             (int(block.message.slot), bytes(root))
                         )
+            moves.sort()
+            prunes.sort()
+            step = max(int(self.migration_chunk_slots), 1)
+            mi = pi = 0
+            lo = old_split
+            while lo < finalized_slot:
+                hi = min(lo + step, finalized_slot)
+                batch = self.batch()
+                chunks = _ChunkWriter(self.kv)
+                window = []
+                while mi < len(moves) and moves[mi][0] < hi:
+                    slot, root = moves[mi]
+                    mi += 1
+                    data = self.kv.get(Column.BLOCK, root)
+                    if data is None:
+                        continue  # vanished since the scan (re-run overlap)
+                    batch.stage(Column.FREEZER_BLOCK, root, data)
                     batch.stage_delete(Column.BLOCK, root)
-            self._freeze_block_roots(
-                old_split, finalized_slot, migrated, chunks
-            )
-            filled_to = self._state_roots_filled_to
+                    window.append((slot, root))
+                while pi < len(prunes) and prunes[pi][0] < hi:
+                    batch.stage_delete(Column.BLOCK, prunes[pi][1])
+                    pi += 1
+                self._freeze_block_roots(lo, hi, window, chunks)
+                chunks.flush_into(batch)
+                batch.commit()
+                lo = hi
             if finalized_state is not None:
+                batch = self.batch()
+                chunks = _ChunkWriter(self.kv)
                 filled_to = self._freeze_state_roots(
                     finalized_slot, finalized_state, chunks, batch
                 )
-            self._store_restore_points(finalized_slot, chunks, batch)
-            chunks.flush_into(batch)
-            batch.stage_chain_item(
-                b"split_slot", struct.pack(">Q", finalized_slot)
-            )
-            batch.stage_chain_item(
-                b"slots_per_restore_point",
-                struct.pack(">Q", self.slots_per_restore_point),
-            )
+                chunks.flush_into(batch)
+                batch.commit()
+                self._state_roots_filled_to = filled_to
+            self._sweep_restore_points(finalized_slot)
+            # the split-slot advance is the LAST batch: a crash anywhere
+            # above leaves the old split naming only content that exists.
+            # Values are staged only when they CHANGE — finality triggers
+            # a migrate call per import, and a no-advance repeat must not
+            # journal an identical marker batch every slot.
+            batch = self.batch()
+            markers = [
+                (b"split_slot", struct.pack(">Q", finalized_slot)),
+                (
+                    b"slots_per_restore_point",
+                    struct.pack(">Q", self.slots_per_restore_point),
+                ),
+            ]
             if finalized_block_root is not None:
-                batch.stage_chain_item(
-                    b"finalized_block_root", bytes(finalized_block_root)
+                markers.append(
+                    (b"finalized_block_root", bytes(finalized_block_root))
                 )
+            for key, value in markers:
+                if self.get_chain_item(key) != value:
+                    batch.stage_chain_item(key, value)
             batch.commit()
             # in-memory mirrors advance only AFTER the batch is durable,
             # so a commit-time crash leaves this object consistent with
             # the disk
             self.split_slot = finalized_slot
-            self._state_roots_filled_to = filled_to
 
-    def _freeze_block_roots(
-        self, old_split: int, finalized_slot: int, migrated, chunks
-    ) -> None:
-        """Per-slot block roots for [old_split, finalized_slot) from the
-        migrated canonical blocks themselves (ring semantics: an empty slot
-        repeats the previous block's root) — coverage never depends on any
-        state's ring buffer, so long non-finality cannot punch holes.
-        Rows are staged on the shared `chunks` overlay; the migration
-        batch flushes them."""
+    def _freeze_block_roots(self, lo: int, hi: int, migrated, chunks) -> None:
+        """Per-slot block roots for the window [lo, hi) from the migrated
+        canonical blocks themselves (ring semantics: an empty slot repeats
+        the previous block's root) — coverage never depends on any state's
+        ring buffer, so long non-finality cannot punch holes. Rows are
+        staged on the shared `chunks` overlay; the window batch flushes
+        them. An EXISTING stored root wins over recomputation and becomes
+        the running `prev`: a re-run over a window a crashed migration
+        already committed (whose hot blocks are gone, so `migrated` no
+        longer names them) must keep the recorded canonical roots instead
+        of smearing a stale predecessor over them."""
         migrated.sort()
         cursor = 0
         prev = (
-            chunks.root_at(Column.FREEZER_BLOCK_ROOTS, old_split - 1)
-            if old_split
+            chunks.root_at(Column.FREEZER_BLOCK_ROOTS, lo - 1)
+            if lo
             else None
         )
-        for slot in range(old_split, finalized_slot):
+        row_cache: dict[int, bytes | None] = {}
+
+        def existing_root(slot: int) -> bytes | None:
+            # one kv read per 128-slot chunk row, not one per slot
+            cindex = slot // CHUNK_SIZE
+            staged = chunks.rows.get((Column.FREEZER_BLOCK_ROOTS, cindex))
+            if staged is not None:
+                return chunk_root_in_row(bytes(staged), slot)
+            if cindex not in row_cache:
+                row_cache[cindex] = self.kv.get(
+                    Column.FREEZER_BLOCK_ROOTS, struct.pack(">Q", cindex)
+                )
+            return chunk_root_in_row(row_cache[cindex], slot)
+
+        for slot in range(lo, hi):
             while cursor < len(migrated) and migrated[cursor][0] <= slot:
                 prev = migrated[cursor][1]
                 cursor += 1
+            stored = existing_root(slot)
+            if stored is not None:
+                prev = stored
+                continue
             if prev is None:
                 # before the first canonical block: slot 0's "block" is the
                 # genesis header, recorded at chain init. Databases that
@@ -503,6 +581,27 @@ class HotColdDB:
                 b"restore_points_to", struct.pack(">Q", finalized_slot)
             )
 
+    def _sweep_restore_points(self, upto_slot: int) -> None:
+        """Store missing restore points below `upto_slot` in per-stride
+        journaled batches (at most ONE rebuilt full state staged per
+        commit — the migration's memory bound), starting from the
+        restore_points_to marker's floor. Caller holds _mutation_lock."""
+        spr = self.slots_per_restore_point
+        stored = self.get_chain_item(b"restore_points_to")
+        cursor = struct.unpack(">Q", stored)[0] if stored else 0
+        if cursor >= upto_slot:
+            return
+        while True:
+            upto = min(cursor + spr, upto_slot)
+            batch = self.batch()
+            self._store_restore_points(
+                upto, _ChunkWriter(self.kv), batch, scan_from=cursor
+            )
+            batch.commit()
+            if upto >= upto_slot:
+                return
+            cursor = upto
+
     def reconstruct_historic_states(self) -> int:
         """Fill any missing restore-point states below the split from the
         chunked columns (the reference's historic state reconstruction,
@@ -542,9 +641,7 @@ class HotColdDB:
         while rp_slot >= 0:
             data = self.kv.get(Column.FREEZER_STATE, slot_key(rp_slot))
             if data is not None:
-                fork, _, body = data[1:].partition(b"\x00")
-                t = types_for(self.preset)
-                base = state_class_for(t, fork.decode()).from_ssz_bytes(body)
+                base = self.decode_stored_state(data)
                 break
             rp_slot -= spr
         if base is None:

@@ -74,6 +74,8 @@ def _load():
     lib.kv_compact.argtypes = [ctypes.c_void_p]
     lib.kv_len.restype = ctypes.c_size_t
     lib.kv_len.argtypes = [ctypes.c_void_p]
+    u64p = ctypes.POINTER(ctypes.c_uint64)
+    lib.kv_recovery_stats.argtypes = [ctypes.c_void_p, u64p, u64p, u64p]
     _LIB = lib
     return lib
 
@@ -89,6 +91,32 @@ class NativeStore(KeyValueStore):
         if not self._db:
             raise OSError(f"kv_open failed for {path}")
         self._lock = threading.Lock()
+        # surface the C++ log's open-time recovery outcomes into the
+        # shared metrics registry (the python-WAL counters' native twin)
+        self.recovery_stats = self._read_recovery_stats()
+        from ..utils import metrics as M
+
+        M.STORE_NATIVE_REPLAYED.inc(self.recovery_stats["replayed_batches"])
+        M.STORE_NATIVE_ROLLED_BACK.inc(
+            self.recovery_stats["rolled_back_batches"]
+        )
+        M.STORE_NATIVE_TRUNCATED.inc(self.recovery_stats["truncated_bytes"])
+
+    def _read_recovery_stats(self) -> dict:
+        replayed = ctypes.c_uint64()
+        rolled_back = ctypes.c_uint64()
+        truncated = ctypes.c_uint64()
+        self._lib.kv_recovery_stats(
+            self._db,
+            ctypes.byref(replayed),
+            ctypes.byref(rolled_back),
+            ctypes.byref(truncated),
+        )
+        return {
+            "replayed_batches": int(replayed.value),
+            "rolled_back_batches": int(rolled_back.value),
+            "truncated_bytes": int(truncated.value),
+        }
 
     def close(self) -> None:
         with self._lock:

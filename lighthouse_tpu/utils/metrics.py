@@ -118,6 +118,36 @@ class Histogram:
         finally:
             self.observe(time.perf_counter() - t0)
 
+    def snapshot(self) -> tuple:
+        """Point-in-time copy of (bucket_counts, count, sum): the delta
+        base for windowed quantiles (scenario SLO checks subtract a
+        start-of-run snapshot so process-global history doesn't bleed
+        into the scenario's verdict)."""
+        with self._lock:
+            return list(self.bucket_counts), self.count, self.sum
+
+    def quantile(self, q: float, since: tuple | None = None) -> float | None:
+        """Upper-bound estimate of the q-quantile from the bucket counts
+        (linear within the winning bucket's upper edge, like PromQL's
+        histogram_quantile). `since` subtracts an earlier snapshot().
+        None when the (windowed) histogram is empty; the overflow bucket
+        reports the largest finite edge."""
+        with self._lock:
+            counts = list(self.bucket_counts)
+        if since is not None:
+            base = since[0]
+            counts = [c - b for c, b in zip(counts, base)]
+        total = sum(counts)
+        if total <= 0:
+            return None
+        rank = q * total
+        cum = 0
+        for edge, c in zip(self.buckets, counts):
+            cum += c
+            if cum >= rank:
+                return edge
+        return self.buckets[-1]
+
     def expose(self) -> list[str]:
         out = [
             f"# HELP {self.name} {escape_help(self.help)}",
@@ -253,6 +283,11 @@ BLOCKS_REJECTED = REGISTRY.counter(
 ATTESTATIONS_PROCESSED = REGISTRY.counter(
     "beacon_attestations_processed_total", "Gossip attestations verified"
 )
+BLOCK_EQUIVOCATIONS = REGISTRY.counter(
+    "beacon_block_equivocations_total",
+    "Gossip blocks IGNOREd as a second distinct proposal from the same "
+    "(slot, proposer) — handed to the slasher, never imported via gossip",
+)
 
 # -- the resilience metric family (lighthouse_tpu/resilience/) ----------------
 # Retry attempts, breaker transitions, BLS backend degradation, and
@@ -376,6 +411,22 @@ STORE_FSCK_RUNS = REGISTRY.counter(
 )
 STORE_FSCK_FAILURES = REGISTRY.counter(
     "store_fsck_issues_total", "Consistency violations found by db fsck"
+)
+# NativeStore (C++ log-structured backend) open-time recovery outcomes:
+# the native twin of the python-WAL replay/rollback counters above,
+# read back from the C side via kv_recovery_stats at every open.
+STORE_NATIVE_REPLAYED = REGISTRY.counter(
+    "store_native_replayed_batches_total",
+    "Committed native-log batches re-applied during store open replay",
+)
+STORE_NATIVE_ROLLED_BACK = REGISTRY.counter(
+    "store_native_rolled_back_batches_total",
+    "Uncommitted native-log batches dropped during store open replay "
+    "(the crash hit between BATCH_BEGIN and BATCH_COMMIT)",
+)
+STORE_NATIVE_TRUNCATED = REGISTRY.counter(
+    "store_native_truncated_bytes_total",
+    "Torn native-log tail bytes truncated during store open replay",
 )
 
 # -- slot-relative delay family (reference beacon_block_delay_* in

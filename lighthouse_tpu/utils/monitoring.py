@@ -210,8 +210,41 @@ class MonitoringRig:
         self._server.server_close()
 
 
+def trace_health_fields(tracer=None) -> dict:
+    """Trace/metrics-derived health: p95 work-span durations per
+    processor lane (from the span ring) plus queue-wait and slot-delay
+    p95s (from the shared registry's histograms). This is the ONE code
+    path for these numbers — the remote monitoring push attaches them
+    and the scenario harness's SLO checker asserts against them."""
+    from . import metrics as M
+    from . import tracing
+
+    t = tracer if tracer is not None else tracing.default_tracer()
+    work: dict[str, list[float]] = {}
+    for s in t.finished_spans():
+        if s.name.startswith("work/") and s.end is not None:
+            work.setdefault(s.name[len("work/"):], []).append(s.duration())
+    fields: dict = {}
+    for lane, durs in sorted(work.items()):
+        durs.sort()
+        idx = min(len(durs) - 1, int(0.95 * len(durs)))
+        fields[f"work_p95_{lane}_seconds"] = round(durs[idx], 9)
+    pairs = (
+        ("queue_wait", M.PROCESSOR_QUEUE_WAIT),
+        ("block_observed_delay", M.BLOCK_OBSERVED_DELAY),
+        ("block_imported_delay", M.BLOCK_IMPORTED_DELAY),
+        ("block_head_delay", M.BLOCK_HEAD_DELAY),
+    )
+    for name, hist in pairs:
+        v = hist.quantile(0.95)
+        if v is not None:
+            fields[f"{name}_p95_seconds"] = v
+    return fields
+
+
 def beacon_node_source(chain) -> dict:
-    """Chain-level fields for the beacon_node record (lib.rs:218-243)."""
+    """Chain-level fields for the beacon_node record (lib.rs:218-243),
+    plus the trace-derived health block (PR-5 follow-up)."""
     head_root, head_state = chain.head()
     fin_epoch, _ = chain.finalized_checkpoint
     return {
@@ -221,4 +254,5 @@ def beacon_node_source(chain) -> dict:
         "finalized_epoch": int(fin_epoch),
         "validator_count": len(head_state.validators),
         "is_synced": int(chain.current_slot) <= int(head_state.slot) + 1,
+        "health": trace_health_fields(),
     }

@@ -269,6 +269,12 @@ class Tracer:
 
     # -- export -------------------------------------------------------------
 
+    def finished_spans(self) -> list[Span]:
+        """Snapshot of the finished ring (monitoring's trace-derived
+        health fields and the scenario SLO checker read through this)."""
+        with self._lock:
+            return list(self.finished)
+
     def status(self) -> dict:
         with self._lock:
             return {

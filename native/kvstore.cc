@@ -77,6 +77,12 @@ void encode(const Record& r, std::vector<uint8_t>* out) {
 struct Db {
   std::string path;
   FILE* log = nullptr;
+  // open-time recovery outcomes (surfaced to the host's metrics registry
+  // via kv_recovery_stats): committed batches re-applied, uncommitted
+  // batches dropped, torn-tail bytes truncated
+  uint64_t replayed_batches = 0;
+  uint64_t rolled_back_batches = 0;
+  uint64_t truncated_bytes = 0;
   // (col, key) -> value; tombstoned entries removed
   std::map<std::pair<std::string, std::string>, std::string> index;
 
@@ -138,11 +144,13 @@ void replay(Db* db) {
     r.key.assign(reinterpret_cast<char*>(&body[11 + cl]), kl);
     r.val.assign(reinterpret_cast<char*>(&body[11 + cl + kl]), vl);
     if (op == OP_BATCH_BEGIN) {
+      if (in_batch) db->rolled_back_batches++;  // begin with no commit
       in_batch = true;
       pending.clear();
     } else if (op == OP_BATCH_COMMIT) {
       for (const auto& p : pending) db->apply(p);
       pending.clear();
+      if (in_batch) db->replayed_batches++;
       in_batch = false;
       good_end = ftell(f);
     } else if (in_batch) {
@@ -152,12 +160,14 @@ void replay(Db* db) {
       good_end = ftell(f);
     }
   }
+  if (in_batch) db->rolled_back_batches++;  // crash mid-batch: dropped
   fclose(f);
   // drop any torn tail so future appends start at a clean boundary
   FILE* t = fopen(db->path.c_str(), "rb+");
   if (t) {
     fseek(t, 0, SEEK_END);
     if (ftell(t) != good_end) {
+      db->truncated_bytes += uint64_t(ftell(t) - good_end);
       fflush(t);
 #ifdef _WIN32
       (void)good_end;
@@ -334,6 +344,17 @@ int kv_compact(void* h) {
 
 size_t kv_len(void* h) {
   return static_cast<Db*>(h)->index.size();
+}
+
+// open-time recovery outcomes (counted once, during kv_open's replay):
+// committed batches re-applied, uncommitted batches dropped, torn-tail
+// bytes truncated. The host surfaces these into its metrics registry.
+void kv_recovery_stats(void* h, uint64_t* replayed, uint64_t* rolled_back,
+                       uint64_t* truncated_bytes) {
+  Db* db = static_cast<Db*>(h);
+  if (replayed) *replayed = db->replayed_batches;
+  if (rolled_back) *rolled_back = db->rolled_back_batches;
+  if (truncated_bytes) *truncated_bytes = db->truncated_bytes;
 }
 
 }  // extern "C"

@@ -58,6 +58,12 @@ def pytest_configure(config):
         "crash: deterministic crash-injection matrix (store WAL recovery); "
         "CI also runs these as a dedicated step",
     )
+    config.addinivalue_line(
+        "markers",
+        "scenario: deterministic adversarial scenario harness runs "
+        "(partitions/churn/storms/non-finality/crash-recovery); the "
+        "dedicated scenario CI job runs the full matrix including slow",
+    )
 
 
 def pytest_collection_modifyitems(session, config, items):

@@ -140,6 +140,50 @@ def migration_batches(kv: RecordingStore):
     ]
 
 
+_MIGRATION_CHAIN_KEYS = {
+    b"split_slot",
+    b"slots_per_restore_point",
+    b"finalized_block_root",
+    b"state_roots_filled_to",
+    b"restore_points_to",
+}
+
+
+def _is_migration_batch(ops) -> bool:
+    for op, col, key, _v in ops:
+        if col in (
+            Column.FREEZER_BLOCK,
+            Column.FREEZER_STATE,
+            Column.FREEZER_BLOCK_ROOTS,
+            Column.FREEZER_STATE_ROOTS,
+        ):
+            continue
+        if col == Column.BLOCK and op == "delete":
+            continue
+        if col == Column.CHAIN and key in _MIGRATION_CHAIN_KEYS:
+            continue
+        return False
+    return True
+
+
+def last_migration_run(kv: RecordingStore):
+    """The SUB-BATCH run of the last hot->cold migration: the maximal
+    stretch of consecutive migration-only batches ending at the last
+    split-slot marker batch (which migrate_to_freezer commits LAST)."""
+    marker_idx = max(
+        i
+        for i, (_pre, ops) in enumerate(kv.batches)
+        if any(
+            op == "put" and col == Column.CHAIN and key == b"split_slot"
+            for op, col, key, _v in ops
+        )
+    )
+    start = marker_idx
+    while start > 0 and _is_migration_batch(kv.batches[start - 1][1]):
+        start -= 1
+    return kv.batches[start : marker_idx + 1]
+
+
 # --- journal protocol (backend-level) ---------------------------------------
 
 
@@ -296,14 +340,51 @@ class TestMigrationCrashMatrix:
         assert run_fsck(h.store) == []
 
     def test_crash_at_every_op_of_migration(self, finalized_recording):
-        """The acceptance matrix: a crash at EVERY kv op index of the
-        last hot->cold migration batch recovers to an fsck-clean store
-        equal to the pre or post image, and the chain resumes with
-        bit-identical head/finalized roots."""
+        """The acceptance matrix over the SUB-BATCHED migration: a crash
+        at EVERY kv op index of EVERY sub-batch of the last hot->cold
+        migration recovers to an fsck-clean store equal to that
+        sub-batch's pre or post image."""
         h, kv = finalized_recording
-        pre, ops = migration_batches(kv)[-1]
-        assert len(ops) > 20, "migration batch suspiciously small"
-        crash_matrix(pre, ops, _open_minimal(h.spec))
+        run = last_migration_run(kv)
+        assert len(run) >= 3, "expected window + roots + marker sub-batches"
+        assert sum(len(ops) for _pre, ops in run) > 20, (
+            "migration run suspiciously small"
+        )
+        # the split-slot advance must be the LAST sub-batch of the run
+        assert any(
+            key == b"split_slot" for _op, _c, key, _v in run[-1][1]
+        )
+        for pre, ops in run:
+            crash_matrix(pre, ops, _open_minimal(h.spec))
+
+    def test_crash_between_migration_sub_batches_is_consistent(
+        self, finalized_recording
+    ):
+        """An inter-batch crash point (some sub-batches durable, the
+        rest never ran — including frozen content with a stale split
+        marker) must reopen fsck-clean and resume onto the same head as
+        a crash-free run."""
+        h, kv = finalized_recording
+        run = last_migration_run(kv)
+        clean = mem_copy(run[0][0])
+        for _pre, ops in run:
+            clean.do_atomically(ops)
+        reference = BeaconChain.from_store(
+            HotColdDB(clean, MINIMAL, h.spec, slots_per_restore_point=EPOCH),
+            MINIMAL,
+            h.spec,
+        )
+        for k in range(1, len(run)):
+            # pre-image of sub-batch k == sub-batches 0..k-1 applied
+            store = mem_copy(run[k][0])
+            db = HotColdDB(
+                store, MINIMAL, h.spec, slots_per_restore_point=EPOCH
+            )
+            assert run_fsck(db) == [], f"dirty between sub-batches at {k}"
+            chain = BeaconChain.from_store(db, MINIMAL, h.spec)
+            assert chain.head_root == reference.head_root, (
+                f"resume diverged between sub-batches at {k}"
+            )
 
     def test_resumed_chain_roots_bit_identical(self, finalized_recording):
         """End-to-end resume across a crash-recovered migration: sample
@@ -311,33 +392,35 @@ class TestMigrationCrashMatrix:
         and FromStore must land on the same head/finalized roots as a
         crash-free run."""
         h, kv = finalized_recording
-        pre, ops = migration_batches(kv)[-1]
-        clean = mem_copy(pre)
-        clean.do_atomically(ops)
+        run = last_migration_run(kv)
+        clean = mem_copy(run[0][0])
+        for _pre, ops in run:
+            clean.do_atomically(ops)
         reference = BeaconChain.from_store(
             HotColdDB(clean, MINIMAL, h.spec, slots_per_restore_point=EPOCH),
             MINIMAL,
             h.spec,
         )
-        total = len(ops) + 2
-        for crash_at in (0, 1, total // 2, total - 1):
-            store = mem_copy(pre)
-            wrapped = CrashingStore(store, CrashPlan(crash_at=crash_at))
-            with pytest.raises(InjectedCrash):
-                wrapped.do_atomically(ops)
-            db = HotColdDB(
-                store, MINIMAL, h.spec, slots_per_restore_point=EPOCH
-            )
-            chain = BeaconChain.from_store(db, MINIMAL, h.spec)
-            assert chain.head_root == reference.head_root
-            assert (
-                chain.head_state.tree_hash_root()
-                == reference.head_state.tree_hash_root()
-            )
-            assert (
-                chain.head_state.finalized_checkpoint.epoch
-                == reference.head_state.finalized_checkpoint.epoch
-            )
+        for pre, ops in run:
+            total = len(ops) + 2
+            for crash_at in (0, 1, total // 2, total - 1):
+                store = mem_copy(pre)
+                wrapped = CrashingStore(store, CrashPlan(crash_at=crash_at))
+                with pytest.raises(InjectedCrash):
+                    wrapped.do_atomically(ops)
+                db = HotColdDB(
+                    store, MINIMAL, h.spec, slots_per_restore_point=EPOCH
+                )
+                chain = BeaconChain.from_store(db, MINIMAL, h.spec)
+                assert chain.head_root == reference.head_root
+                assert (
+                    chain.head_state.tree_hash_root()
+                    == reference.head_state.tree_hash_root()
+                )
+                assert (
+                    chain.head_state.finalized_checkpoint.epoch
+                    == reference.head_state.finalized_checkpoint.epoch
+                )
 
     def test_torn_migration_journal_rolls_back(self, finalized_recording):
         """A torn intent write (half the journal blob on disk) must roll
@@ -533,7 +616,18 @@ class TestCorruptHeadFallback:
     def test_corrupt_head_falls_back_to_finalized(
         self, finalized_recording, capsys
     ):
+        """A corrupt head pointer falls back to the finalized anchor —
+        and the hot-block replay then RECOVERS the unfinalized tip (the
+        from_store fork-choice rebuild), so the resumed head matches an
+        uncorrupted resume, not just the finalized block."""
         h, kv = finalized_recording
+        reference = BeaconChain.from_store(
+            HotColdDB(
+                mem_copy(kv), MINIMAL, h.spec, slots_per_restore_point=EPOCH
+            ),
+            MINIMAL,
+            h.spec,
+        )
         store_kv = mem_copy(kv)
         db = HotColdDB(
             store_kv, MINIMAL, h.spec, slots_per_restore_point=EPOCH
@@ -542,13 +636,23 @@ class TestCorruptHeadFallback:
         assert fin_root is not None, "migration persisted no finalized root"
         db.put_chain_item(b"head_block_root", b"\xde\xad" * 16)
         chain = BeaconChain.from_store(db, MINIMAL, h.spec)
-        assert chain.head_root == fin_root
+        assert chain.head_root == reference.head_root
+        assert chain.head_state.slot >= reference.head_state.slot
         err = capsys.readouterr().err
         assert "head pointer corrupt" in err
         assert "falling back" in err
 
     def test_missing_head_state_row_falls_back(self, finalized_recording):
+        """A missing head-state row resumes via the finalized anchor and
+        the replay re-imports the tip, re-materializing the state row."""
         h, kv = finalized_recording
+        reference = BeaconChain.from_store(
+            HotColdDB(
+                mem_copy(kv), MINIMAL, h.spec, slots_per_restore_point=EPOCH
+            ),
+            MINIMAL,
+            h.spec,
+        )
         store_kv = mem_copy(kv)
         db = HotColdDB(
             store_kv, MINIMAL, h.spec, slots_per_restore_point=EPOCH
@@ -557,7 +661,11 @@ class TestCorruptHeadFallback:
         store_kv.delete(Column.STATE, head_state_root)
         store_kv.delete(Column.STATE_SUMMARY, head_state_root)
         chain = BeaconChain.from_store(db, MINIMAL, h.spec)
-        assert chain.head_root == db.get_chain_item(b"finalized_block_root")
+        assert chain.head_root == reference.head_root
+        assert (
+            store_kv.get(Column.STATE, head_state_root) is not None
+            or store_kv.get(Column.STATE_SUMMARY, head_state_root) is not None
+        )
 
     def test_no_fallback_still_raises(self):
         from lighthouse_tpu.chain.beacon_chain import BlockError
@@ -571,7 +679,83 @@ class TestCorruptHeadFallback:
 # --- fsck detects real corruption -------------------------------------------
 
 
+@pytest.mark.crash
+class TestOpPoolPersistCrashMatrix:
+    """The op-pool persist blob's rewrite commits through the WAL
+    (PR-4 carry-over): a crash at any kv op of the rewrite leaves the
+    OLD blob or the NEW one byte-identically, never a torn prefix."""
+
+    def test_persist_rewrite_pre_or_post(self):
+        from lighthouse_tpu.harness import StateHarness
+        from lighthouse_tpu.pool import OperationPool
+
+        h = StateHarness(16, MINIMAL, SPEC, sign=False)
+        h.extend_chain(3, attest=False)
+        kv = RecordingStore()
+        db = HotColdDB(kv, MINIMAL, SPEC)
+        pool = OperationPool(MINIMAL, SPEC)
+        pool.insert_attestation(h.attestations_for_slot(h.state, 1)[0])
+        pool.persist(db)
+        old_blob = db.get_chain_item(b"op_pool_v1")
+        assert old_blob, "first persist wrote no blob"
+        pool.insert_attestation(h.attestations_for_slot(h.state, 2)[0])
+        pool.persist(db)
+        pre, ops = kv.batches[-1]
+        assert [
+            (op, col, key) for op, col, key, _v in ops
+        ] == [("put", Column.CHAIN, b"op_pool_v1")], (
+            "persist must journal exactly the blob rewrite"
+        )
+        assert pre.get(Column.CHAIN, b"op_pool_v1") == old_blob
+        crash_matrix(pre, ops, _open_minimal(SPEC))
+
+
 class TestFsckDetectsCorruption:
+    def test_corrupt_frozen_block_reported(self, finalized_recording):
+        """The freezer-decodability walk: a frozen block row that exists
+        but does not decode (torn tail, bit rot) is an fsck issue, not a
+        latent historical-replay crash."""
+        h, kv = finalized_recording
+        store = mem_copy(kv)
+        db = HotColdDB(store, MINIMAL, h.spec, slots_per_restore_point=EPOCH)
+        roots = store.keys(Column.FREEZER_BLOCK)
+        assert roots, "recording froze no blocks"
+        store.put(Column.FREEZER_BLOCK, roots[0], b"phase0\x00garbage")
+        issues = run_fsck(db)
+        assert any(
+            i.check == "freezer-decode" and "block" in i.detail
+            for i in issues
+        ), [str(i) for i in issues]
+
+    def test_wrong_root_frozen_block_reported(self, finalized_recording):
+        """A VALID block stored under the WRONG key decodes fine but
+        must still fail the decodability walk (key/root agreement)."""
+        h, kv = finalized_recording
+        store = mem_copy(kv)
+        db = HotColdDB(store, MINIMAL, h.spec, slots_per_restore_point=EPOCH)
+        roots = store.keys(Column.FREEZER_BLOCK)
+        assert len(roots) >= 2
+        store.put(
+            Column.FREEZER_BLOCK,
+            roots[0],
+            store.get(Column.FREEZER_BLOCK, roots[1]),
+        )
+        issues = run_fsck(db)
+        assert any(i.check == "freezer-decode" for i in issues)
+
+    def test_corrupt_restore_point_reported(self, finalized_recording):
+        h, kv = finalized_recording
+        store = mem_copy(kv)
+        db = HotColdDB(store, MINIMAL, h.spec, slots_per_restore_point=EPOCH)
+        keys = store.keys(Column.FREEZER_STATE)
+        assert keys, "recording stored no restore points"
+        store.put(Column.FREEZER_STATE, keys[0], b"Fphase0\x00garbage")
+        issues = run_fsck(db)
+        assert any(
+            i.check == "freezer-decode" and "state" in i.detail
+            for i in issues
+        ), [str(i) for i in issues]
+
     def test_orphan_journal_reported(self, finalized_recording):
         h, kv = finalized_recording
         store_kv = mem_copy(kv)

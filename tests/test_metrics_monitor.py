@@ -597,3 +597,83 @@ class TestEpochGrading:
             assert "epoch_summaries" in any_stats
         finally:
             server.stop()
+
+
+class TestHistogramQuantiles:
+    """Bucket-quantile estimation + snapshot deltas (the scenario SLO
+    checker and monitoring's trace-health fields share this math)."""
+
+    def test_quantile_upper_bound_estimate(self):
+        h = Histogram("q_test_seconds", "h", buckets=(0.1, 1.0, 10.0))
+        assert h.quantile(0.95) is None
+        for _ in range(95):
+            h.observe(0.05)
+        for _ in range(5):
+            h.observe(5.0)
+        assert h.quantile(0.5) == 0.1
+        assert h.quantile(0.99) == 10.0
+
+    def test_quantile_since_snapshot_windows_out_history(self):
+        h = Histogram("q_window_seconds", "h", buckets=(0.1, 1.0))
+        for _ in range(100):
+            h.observe(5.0)  # old noise in the overflow bucket
+        snap = h.snapshot()
+        for _ in range(10):
+            h.observe(0.05)
+        assert h.quantile(0.95) == 1.0  # unwindowed: dominated by noise
+        assert h.quantile(0.95, since=snap) == 0.1  # windowed: clean
+        empty = h.snapshot()
+        assert h.quantile(0.5, since=empty) is None
+
+    def test_overflow_bucket_reports_largest_edge(self):
+        h = Histogram("q_inf_seconds", "h", buckets=(0.1, 1.0))
+        h.observe(100.0)
+        assert h.quantile(0.5) == 1.0
+
+
+class TestNativeRecoveryMetrics:
+    """NativeStore surfaces the C++ log's open-time replay/rollback
+    counts into the shared registry (PR-4 carry-over)."""
+
+    def test_replay_and_rollback_counted(self, tmp_path):
+        from lighthouse_tpu.store.native_kv import NativeStore
+        from lighthouse_tpu.utils import metrics as M
+
+        path = str(tmp_path / "chain.db")
+        s = NativeStore(path)
+        assert s.recovery_stats == {
+            "replayed_batches": 0,
+            "rolled_back_batches": 0,
+            "truncated_bytes": 0,
+        }
+        s.do_atomically([("put", b"chn", b"a", b"1")])
+        # an UNCOMMITTED batch: BEGIN + member record, no COMMIT — the
+        # shape a process death leaves in the log
+        s._lib.kv_batch_begin(s._handle())
+        s._lib.kv_batch_put(s._handle(), b"chn", 3, b"b", 1, b"2", 1)
+        s.close()
+
+        base_replayed = M.STORE_NATIVE_REPLAYED.value
+        base_rolled = M.STORE_NATIVE_ROLLED_BACK.value
+        base_trunc = M.STORE_NATIVE_TRUNCATED.value
+        s2 = NativeStore(path)
+        try:
+            assert s2.recovery_stats["replayed_batches"] == 1
+            assert s2.recovery_stats["rolled_back_batches"] == 1
+            assert s2.recovery_stats["truncated_bytes"] > 0
+            assert s2.get(b"chn", b"a") == b"1"
+            assert s2.get(b"chn", b"b") is None  # uncommitted: dropped
+            assert M.STORE_NATIVE_REPLAYED.value == base_replayed + 1
+            assert M.STORE_NATIVE_ROLLED_BACK.value == base_rolled + 1
+            assert M.STORE_NATIVE_TRUNCATED.value > base_trunc
+        finally:
+            s2.close()
+
+    def test_native_families_exposed(self):
+        text = REGISTRY.expose()
+        for family in (
+            "store_native_replayed_batches_total",
+            "store_native_rolled_back_batches_total",
+            "store_native_truncated_bytes_total",
+        ):
+            assert f"# TYPE {family} counter" in text

@@ -120,3 +120,54 @@ def test_periodic_loop_and_chain_source():
     finally:
         svc.stop()
         rig.stop()
+
+
+def test_trace_health_fields_attach_to_push():
+    """Trace-derived health (p95 work durations, queue wait, slot-delay
+    p95s) rides the beacon_node record — the same helper the scenario
+    SLO checker reads (one code path)."""
+    import random
+
+    from lighthouse_tpu.chain.beacon_chain import BeaconChain
+    from lighthouse_tpu.store.hot_cold import HotColdDB
+    from lighthouse_tpu.store.kv import MemoryStore
+    from lighthouse_tpu.types import ChainSpec, MINIMAL, interop_genesis_state
+    from lighthouse_tpu.utils import metrics as M
+    from lighthouse_tpu.utils import tracing
+    from lighthouse_tpu.utils.monitoring import trace_health_fields
+
+    tracer = tracing.configure(
+        rng=random.Random(7), clock=tracing.StepClock(step=1e-6)
+    )
+    with tracer.span("work/gossip_block", n=1):
+        pass
+    with tracer.span("work/gossip_attestation", n=4):
+        pass
+    M.PROCESSOR_QUEUE_WAIT.observe(0.004)
+
+    fields = trace_health_fields()
+    assert fields["work_p95_gossip_block_seconds"] > 0
+    assert fields["work_p95_gossip_attestation_seconds"] > 0
+    assert fields["queue_wait_p95_seconds"] > 0
+
+    spec = ChainSpec.interop()
+    chain = BeaconChain(
+        HotColdDB(MemoryStore(), MINIMAL, spec),
+        interop_genesis_state(16, MINIMAL, spec),
+        MINIMAL,
+        spec,
+    )
+    rig = MonitoringRig().start()
+    try:
+        svc = MonitoringService(
+            rig.url,
+            data_sources={"beacon_node": lambda: beacon_node_source(chain)},
+        )
+        svc.send_once()
+        (body,) = rig.received
+        proc = next(r for r in body if r["sub_type"] == "process")
+        health = proc["data"]["health"]
+        assert health["work_p95_gossip_block_seconds"] > 0
+        assert health["queue_wait_p95_seconds"] > 0
+    finally:
+        rig.stop()

@@ -1,0 +1,306 @@
+"""Deterministic adversarial scenario matrix (harness/scenario.py):
+partitions, churn, equivocation storms, long non-finality, and
+mid-scenario crash-recovery, under per-slot safety invariants and
+end-of-run SLO checks.
+
+Tier-1 keeps ONE small seeded scenario plus the bit-identical replay
+assertion (the acceptance contract); the full five-family matrix and the
+many-node scale runs are `slow` and ride the dedicated `scenario` CI job
+(`make test-scenario`).
+"""
+
+from __future__ import annotations
+
+from types import SimpleNamespace
+
+import pytest
+
+from lighthouse_tpu.crypto.bls import set_backend
+from lighthouse_tpu.harness.scenario import (
+    PLANS,
+    InvariantChecker,
+    InvariantViolation,
+    Phase,
+    SLO,
+    ScenarioPlan,
+    assert_bit_identical_replay,
+    long_nonfinality_plan,
+    run_scenario,
+)
+from lighthouse_tpu.types import MINIMAL
+
+SPE = MINIMAL.slots_per_epoch
+
+
+@pytest.fixture(autouse=True)
+def fake_crypto():
+    set_backend("fake")
+    yield
+    set_backend("jax_tpu")
+
+
+def small_partition_plan(seed: int = 0) -> ScenarioPlan:
+    """The tier-1 scenario: 3 nodes, one epoch split, heal, finalize."""
+    return ScenarioPlan(
+        name="partition-small",
+        seed=seed,
+        node_count=3,
+        validator_count=48,
+        phases=(
+            Phase("baseline", slots=SPE),
+            Phase("split", slots=SPE, partition=((0,), (1, 2))),
+            Phase("heal", slots=2 * SPE, heal=True),
+        ),
+        slo=SLO(finality_min_epoch=1),
+    )
+
+
+@pytest.mark.scenario
+class TestTier1Scenario:
+    def test_small_partition_bit_identical_replay(self):
+        """The replay contract end-to-end: two runs of one seeded plan
+        agree on final heads AND export byte-identical traces, and the
+        scenario passes its invariant + SLO checks."""
+        r1, r2 = assert_bit_identical_replay(small_partition_plan())
+        assert r1.report["slo"]["failures"] == []
+        assert r1.report["finalized_epoch"] >= 1
+        assert len(r1.report["final_heads"]) == 1
+        assert r1.report["trace_sha256"] == r2.report["trace_sha256"]
+        assert r1.report["trace_events"] > 0
+        assert r1.report["fsck_issues"] == {}
+
+    def test_different_seeds_export_different_traces(self):
+        """The trace id stream is a function of the plan seed."""
+        a = run_scenario(small_partition_plan(seed=11))
+        b = run_scenario(small_partition_plan(seed=12))
+        assert a.trace != b.trace
+
+
+class TestInvariantChecker:
+    """Unit surface: the checker must actually catch violations."""
+
+    @staticmethod
+    def _node(peer, fe, fr, head_slot=10_000, states=()):
+        genesis = b"\x01" * 32
+        return SimpleNamespace(
+            peer_id=peer,
+            chain=SimpleNamespace(
+                finalized_checkpoint=(fe, fr),
+                head_state=SimpleNamespace(slot=head_slot),
+                head_root=b"\x02" * 32,
+                genesis_block_root=genesis if fr == b"" else fr,
+                _states=set(states),
+            ),
+        )
+
+    @staticmethod
+    def _sim(nodes):
+        return SimpleNamespace(
+            preset=MINIMAL,
+            nodes=nodes,
+            forged_roots=[],
+            equivocation_roots=[],
+        )
+
+    def test_conflicting_finalized_checkpoints_raise(self):
+        a = self._node("a", 2, b"\xaa" * 32)
+        b = self._node("b", 2, b"\xbb" * 32)
+        checker = InvariantChecker(self._sim([a, b]))
+        with pytest.raises(InvariantViolation, match="CONFLICTING"):
+            checker.check_slot(17)
+
+    def test_finality_regression_raises(self):
+        n = self._node("a", 2, b"\xaa" * 32)
+        checker = InvariantChecker(self._sim([n]))
+        checker.check_slot(17)
+        n.chain.finalized_checkpoint = (1, b"\xaa" * 32)
+        with pytest.raises(InvariantViolation, match="regressed"):
+            checker.check_slot(18)
+
+    def test_restart_resets_monotonicity_floor(self):
+        n = self._node("a", 2, b"\xaa" * 32)
+        checker = InvariantChecker(self._sim([n]))
+        checker.check_slot(17)
+        n.chain.finalized_checkpoint = (1, b"\xaa" * 32)
+        checker.note_restart(n)
+        checker.check_slot(18)  # no raise: restart semantics
+
+    def test_head_below_finalized_raises(self):
+        n = self._node("a", 3, b"\xaa" * 32, head_slot=2)
+        checker = InvariantChecker(self._sim([n]))
+        with pytest.raises(InvariantViolation, match="below finalized"):
+            checker.check_slot(30)
+
+    def test_byzantine_import_detected(self):
+        bad = b"\x66" * 32
+        n = self._node("a", 0, b"", states=(bad,))
+        sim = self._sim([n])
+        sim.forged_roots.append(bad)
+        checker = InvariantChecker(sim)
+        with pytest.raises(InvariantViolation, match="Byzantine"):
+            checker.check_slot(5)
+
+
+@pytest.mark.scenario
+@pytest.mark.slow
+class TestScenarioMatrix:
+    """All five scenario families, seeded, invariants + SLOs asserted."""
+
+    @pytest.mark.parametrize("name", sorted(PLANS))
+    def test_family_passes(self, name):
+        result = run_scenario(PLANS[name]())
+        report = result.report
+        assert report["slo"]["failures"] == [], report["slo"]
+        assert len(report["final_heads"]) == 1
+        assert report["fsck_issues"] == {}
+        if name == "equivocation-storm":
+            assert report["byzantine_blocks_gossiped"] > 0
+            assert report["proposer_slashings_found"] > 0
+        if name == "crash-recovery":
+            assert report["crash_recoveries"], "node never crashed"
+            for rec in report["crash_recoveries"]:
+                assert rec["fsck_issues"] == []
+                assert rec["journal_recovery"] in (
+                    "clean", "replayed", "rolled_back",
+                )
+            # the catalogue plan is tuned to die MID-BATCH: the reopen
+            # must exercise a real write-ahead-journal replay
+            assert any(
+                rec["journal_recovery"] == "replayed"
+                for rec in report["crash_recoveries"]
+            ), report["crash_recoveries"]
+        if name == "long-nonfinality":
+            assert report["finalized_epoch"] >= 5
+
+    def test_long_nonfinality_migration_is_sub_batched(self, monkeypatch):
+        """The multi-epoch finality jump must commit its hot->cold
+        migration through MULTIPLE journaled window batches (the
+        resolved single-batch memory trade-off), not one mega-batch."""
+        from lighthouse_tpu.store.kv import Column, MemoryStore
+
+        window_batches: list[int] = []
+        orig = MemoryStore.do_atomically
+
+        def counting(self, ops):
+            ops = list(ops)
+            if any(
+                op == "put" and col == Column.FREEZER_BLOCK
+                for op, col, _k, _v in ops
+            ):
+                window_batches.append(len(ops))
+            return orig(self, ops)
+
+        monkeypatch.setattr(MemoryStore, "do_atomically", counting)
+        result = run_scenario(long_nonfinality_plan())
+        assert result.report["slo"]["failures"] == []
+        # 4 nodes x a multi-window migration each
+        assert len(window_batches) >= 8, window_batches
+
+    def test_storm_during_partition_still_injects(self):
+        """Composed phases: an equivocation storm DURING a split. The
+        Byzantine injector must sit on its victims' side of the bus
+        (join_group) — without it the storm would be vacuous and the
+        slashing SLO could never pass."""
+        plan = ScenarioPlan(
+            name="partition-storm",
+            seed=5,
+            node_count=4,
+            validator_count=64,
+            attach_slashers=True,
+            phases=(
+                Phase("baseline", slots=SPE),
+                Phase(
+                    "split-storm",
+                    slots=SPE,
+                    partition=((0, 1), (2, 3)),
+                    equivocate_every=2,
+                ),
+                Phase("heal", slots=3 * SPE, heal=True),
+            ),
+            slo=SLO(finality_min_epoch=1, expect_proposer_slashings=True),
+        )
+        report = run_scenario(plan).report
+        assert report["slo"]["failures"] == []
+        assert report["byzantine_blocks_gossiped"] > 0
+        assert report["proposer_slashings_found"] > 0
+
+    def test_crash_during_partition_rejoins_its_side(self):
+        """Composed phases: a node dies DURING a split and must reopen
+        back onto ITS side of the partition (group membership is
+        re-established for the fresh node object and peer id), then
+        converge after heal."""
+        plan = ScenarioPlan(
+            name="partition-crash",
+            seed=4,
+            node_count=4,
+            validator_count=64,
+            phases=(
+                Phase("baseline", slots=SPE),
+                Phase(
+                    "split-crash",
+                    slots=SPE,
+                    partition=((0, 1), (2, 3)),
+                    crash_node=3,
+                    crash_after_ops=18,
+                ),
+                Phase("heal", slots=3 * SPE, heal=True),
+            ),
+            slo=SLO(finality_min_epoch=1),
+        )
+        report = run_scenario(plan).report
+        assert report["slo"]["failures"] == []
+        assert report["crash_recoveries"]
+
+    def test_same_node_crashes_twice(self):
+        """A re-armed CrashPlan kills the SAME node in two phases: the
+        reopened store keeps its CrashingStore wrapper, so the second
+        death actually fires and recovers."""
+        plan = ScenarioPlan(
+            name="double-crash",
+            seed=9,
+            node_count=4,
+            validator_count=64,
+            phases=(
+                Phase("baseline", slots=SPE),
+                Phase("crash1", slots=SPE, crash_node=2, crash_after_ops=23),
+                Phase("crash2", slots=SPE, crash_node=2, crash_after_ops=17),
+                Phase("settle", slots=2 * SPE),
+            ),
+            slo=SLO(finality_min_epoch=2),
+        )
+        report = run_scenario(plan).report
+        assert report["slo"]["failures"] == []
+        assert len(report["crash_recoveries"]) == 2, (
+            report["crash_recoveries"]
+        )
+
+    def test_scale_sixteen_nodes_partition(self):
+        plan = ScenarioPlan(
+            name="partition-16",
+            seed=3,
+            node_count=16,
+            validator_count=64,
+            phases=(
+                Phase("baseline", slots=SPE),
+                Phase(
+                    "split",
+                    slots=SPE,
+                    partition=(tuple(range(8)), tuple(range(8, 16))),
+                ),
+                Phase("heal", slots=2 * SPE, heal=True),
+            ),
+            slo=SLO(finality_min_epoch=1),
+        )
+        report = run_scenario(plan).report
+        assert report["slo"]["failures"] == []
+        assert len(report["final_heads"]) == 1
+
+    def test_scale_hundred_nodes_liveness(self):
+        """Hundreds of in-process nodes stay live and convergent for an
+        epoch (the raw simulator scale check, no adversarial phases)."""
+        from lighthouse_tpu.network.simulator import Simulator
+        from lighthouse_tpu.types import ChainSpec
+
+        sim = Simulator(100, 64, MINIMAL, ChainSpec.interop())
+        sim.run_epochs(1)
+        sim.check_all_heads_equal()

@@ -2,7 +2,8 @@
 
 PY ?= python
 
-.PHONY: lint lint-baseline test test-lint test-chaos test-crash test-scenario
+.PHONY: lint lint-baseline test test-lint test-chaos test-crash \
+	test-scenario test-serving bench-serving
 
 ## lint: AST consensus-safety & TPU-hazard pass (tools/lint, stdlib-only)
 lint:
@@ -38,3 +39,12 @@ test-crash:
 test-scenario:
 	JAX_PLATFORMS=cpu $(PY) -m pytest tests/test_scenarios.py -q \
 		-m scenario -p no:cacheprovider
+
+## test-serving: serving-tier suite (cache, SSE fan-out, admission)
+test-serving:
+	JAX_PLATFORMS=cpu $(PY) -m pytest tests/test_serving.py -q \
+		-p no:cacheprovider
+
+## bench-serving: cached-vs-uncached requests/s (the CI serving job)
+bench-serving:
+	JAX_PLATFORMS=cpu $(PY) bench.py --serving --out bench-serving.json

@@ -515,9 +515,31 @@ def profile_child() -> None:
     )
 
 
+def serving_bench() -> None:
+    """`bench.py --serving`: the serving-tier load generator (cached vs
+    uncached requests/s over a real server). Same artifact contract as
+    the BLS bench: exactly ONE JSON line, exit 0 even on failure."""
+    argv = [a for a in sys.argv[1:] if a != "--serving"]
+    try:
+        from tools.serving_load import main as serving_main
+
+        serving_main(argv)
+    except BaseException as exc:  # never lose the artifact
+        _emit(
+            {
+                "metric": "serving_cached_requests_per_s",
+                "value": 0.0,
+                "unit": "req/s",
+                "error": f"serving bench: {type(exc).__name__}: {exc}",
+            }
+        )
+
+
 def main() -> None:
     if "--probe" in sys.argv:
         probe()
+    elif "--serving" in sys.argv:
+        serving_bench()
     elif "--profile" in sys.argv:
         profile_child()
     elif "--child" in sys.argv:

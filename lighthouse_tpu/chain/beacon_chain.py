@@ -90,6 +90,9 @@ class BeaconChain:
         self.fork_choice.justified_balances = _justified_balances(
             genesis_state, preset
         )
+        # highest finalized epoch announced on event_sinks (events.rs
+        # finalized_checkpoint stream); imports past this emit once
+        self._finality_emitted_epoch = int(fc[0])
 
         # genesis/anchor init is ONE atomic batch: the state row, its
         # post-state mapping, the head pointer pair, and the anchors
@@ -593,6 +596,16 @@ class BeaconChain:
             "block",
             {"slot": block.slot, "block": "0x" + block_root.hex()},
         )
+        fin_epoch, fin_root = self.fork_choice.finalized_checkpoint
+        if int(fin_epoch) > self._finality_emitted_epoch:
+            self._finality_emitted_epoch = int(fin_epoch)
+            self.emit(
+                "finalized_checkpoint",
+                {
+                    "epoch": int(fin_epoch),
+                    "block": "0x" + bytes(fin_root).hex(),
+                },
+            )
         self._prune_on_finality()
         return block_root, True
 

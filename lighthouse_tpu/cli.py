@@ -271,7 +271,27 @@ def build_beacon_node(args):
             node.network.range_sync()
         node.wire_bus = bus
     api = BeaconApi(node, network=getattr(node, "network", None))
-    server = BeaconApiServer(api, port=args.http_port)
+    from .serving import ServingConfig
+
+    serving_config = ServingConfig(
+        cache_enabled=not getattr(args, "serving_no_cache", False),
+        cache_max_entries=getattr(args, "serving_cache_entries", 512),
+        sse_max_subscribers=getattr(args, "serving_max_subscribers", 64),
+        queue_wait_p95_threshold_s=getattr(
+            args, "serving_queue_wait_p95", 0.5
+        ),
+        slot_delay_p95_threshold_s=getattr(
+            args, "serving_slot_delay_p95", 4.0
+        ),
+        retry_after_s=getattr(args, "serving_retry_after", 1),
+    )
+    network = getattr(node, "network", None)
+    server = BeaconApiServer(
+        api,
+        port=args.http_port,
+        serving_config=serving_config,
+        processor=getattr(network, "processor", None),
+    )
     return node, server
 
 
@@ -329,7 +349,9 @@ def cmd_bn(args):
         monitoring = MonitoringService(
             args.monitoring_endpoint,
             data_sources={
-                "beacon_node": lambda: beacon_node_source(node.chain)
+                "beacon_node": lambda: beacon_node_source(
+                    node.chain, serving=server.serving
+                )
             },
         ).start()
         log.info("monitoring pushes enabled", endpoint=args.monitoring_endpoint)
@@ -808,6 +830,21 @@ def main(argv=None) -> int:
     bn.add_argument("--dry-run", action="store_true")
     bn.add_argument("--processor-workers", type=int, default=1,
                     help="gossip worker pool size (beacon_processor)")
+    bn.add_argument("--serving-no-cache", action="store_true",
+                    help="disable the anchored HTTP response cache")
+    bn.add_argument("--serving-cache-entries", type=int, default=512,
+                    help="response-cache LRU bound (entries)")
+    bn.add_argument("--serving-max-subscribers", type=int, default=64,
+                    help="concurrent live SSE subscriber cap")
+    bn.add_argument("--serving-queue-wait-p95", type=float, default=0.5,
+                    help="shed threshold: processor queue-wait p95 "
+                         "seconds (debug lane sheds at 1x, read-only "
+                         "at 2x)")
+    bn.add_argument("--serving-slot-delay-p95", type=float, default=4.0,
+                    help="shed threshold: block-import slot-delay p95 "
+                         "seconds")
+    bn.add_argument("--serving-retry-after", type=int, default=1,
+                    help="Retry-After seconds on shed (503) responses")
     bn.set_defaults(fn=cmd_bn)
 
     boot = sub.add_parser("boot-node", help="run a discovery bootnode")

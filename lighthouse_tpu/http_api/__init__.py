@@ -3,6 +3,7 @@ SURVEY.md section 2.3): standard Beacon API handlers, stdlib HTTP server
 with /metrics and SSE events, and the typed client that lets the
 validator client cross the process boundary."""
 
+from ..serving import ServingConfig, ServingTier  # noqa: F401
 from .api import ApiError, BeaconApi  # noqa: F401
 from .client import BeaconNodeHttpClient, Eth2ClientError  # noqa: F401
 from .server import BeaconApiServer  # noqa: F401

@@ -35,7 +35,12 @@ class BeaconApi:
         self.chain = node.chain
         # optional NetworkNode for the node/peers routes
         self.network = network
-        self.events: list = []  # (kind, payload) journal for SSE
+        # bounded (kind, payload) replay journal (debug view; the live
+        # SSE path is the serving tier's broadcaster) — oldest events
+        # age out with a drop counter instead of leaking memory
+        from ..serving import EventRing
+
+        self.events = EventRing(capacity=1024)
         self.chain.event_sinks.append(
             lambda kind, payload: self.events.append((kind, payload))
         )

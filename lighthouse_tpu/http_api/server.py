@@ -1,15 +1,30 @@
 """HTTP adapter for BeaconApi (reference http_api's warp server +
 http_metrics): stdlib ThreadingHTTPServer on an ephemeral port, JSON
 bodies, /eth/v1|v2 routing, Prometheus-style /metrics text, and an SSE
-/eth/v1/events stream fed by the chain's event sinks."""
+/eth/v1/events stream fed by the chain's event sinks.
+
+Requests flow through the serving tier (serving/): admission control
+first (overloaded nodes shed read-only/debug lanes with 503 +
+Retry-After, never validator duties), then the anchored response cache
+for GETs (finalized/head-keyed entries, ETag + If-None-Match -> 304),
+and ``/eth/v1/events?topics=...`` streams live chunked SSE from the
+bounded broadcaster instead of replaying the journal."""
 
 from __future__ import annotations
 
 import json
 import re
 import threading
+import urllib.parse
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 
+from ..serving import (
+    ResponseCache,
+    ServingTier,
+    classify_anchor,
+    classify_lane,
+    make_etag,
+)
 from .api import ApiError, BeaconApi
 
 
@@ -29,15 +44,40 @@ def _liveness_body(body) -> tuple:
 
 
 class BeaconApiServer:
-    def __init__(self, api: BeaconApi, host: str = "127.0.0.1", port: int = 0):
+    def __init__(
+        self,
+        api: BeaconApi,
+        host: str = "127.0.0.1",
+        port: int = 0,
+        serving: ServingTier | None = None,
+        serving_config=None,
+        processor=None,
+    ):
         self.api = api
+        self.serving = (
+            serving
+            if serving is not None
+            else ServingTier(
+                chain=api.chain, config=serving_config, processor=processor
+            )
+        )
         outer = self
 
         class Handler(BaseHTTPRequestHandler):
+            # persistent connections: HTTP/1.0 never keeps alive, and the
+            # per-request body-cache reset below depends on reuse being real
+            protocol_version = "HTTP/1.1"
+
             def log_message(self, *args):  # quiet
                 pass
 
-            def _send(self, status: int, payload, content_type="application/json"):
+            def _send(
+                self,
+                status: int,
+                payload,
+                content_type="application/json",
+                headers: dict | None = None,
+            ):
                 body = (
                     json.dumps(payload).encode()
                     if not isinstance(payload, (bytes, str))
@@ -50,6 +90,8 @@ class BeaconApiServer:
                 self.send_response(status)
                 self.send_header("Content-Type", content_type)
                 self.send_header("Content-Length", str(len(body)))
+                for name, value in (headers or {}).items():
+                    self.send_header(name, value)
                 self.end_headers()
                 self.wfile.write(body)
 
@@ -65,22 +107,40 @@ class BeaconApiServer:
                 except ApiError as e:
                     self._send(e.status, {"message": str(e)})
                 except Exception as e:  # noqa: BLE001
+                    # an unread request body would corrupt the next
+                    # request on a persistent connection
+                    self.close_connection = True
                     self._send(500, {"message": str(e)})
 
             def do_POST(self):
                 try:
                     self._route("POST")
                 except ApiError as e:
+                    self.close_connection = True
                     self._send(e.status, {"message": str(e)})
                 except Exception as e:  # noqa: BLE001
+                    self.close_connection = True
                     self._send(500, {"message": str(e)})
 
             def _route(self, method: str):
                 api = outer.api
+                # the body memo is PER REQUEST: a persistent connection
+                # reuses this handler instance across requests, so a
+                # stale memo would replay request N's body into N+1
+                self._cached = None
                 path, _, query = self.path.partition("?")
                 params = dict(
-                    p.split("=", 1) for p in query.split("&") if "=" in p
+                    urllib.parse.parse_qsl(query, keep_blank_values=True)
                 )
+                lane = classify_lane(method, path)
+                admitted, retry_after = outer.serving.admission.admit(lane)
+                if not admitted:
+                    self._send(
+                        503,
+                        {"message": f"node overloaded, {lane} lane shed"},
+                        headers={"Retry-After": str(retry_after)},
+                    )
+                    return
 
                 def q(name: str) -> str:
                     # a missing required query param is the CLIENT's error
@@ -422,6 +482,12 @@ class BeaconApiServer:
                     )
                     return
                 if method == "GET" and path == "/eth/v1/events":
+                    if "topics" in params:
+                        # live chunked stream from the broadcaster
+                        self._stream_events(params)
+                        return
+                    # bare form: replay-and-close over the bounded ring
+                    # (the debug/journal view; back-compat behaviour)
                     self._send(
                         200,
                         "".join(
@@ -433,19 +499,128 @@ class BeaconApiServer:
                     return
 
                 table = routes_get if method == "GET" else routes_post
-                self._cached_body = None
                 for pattern, handler in table:
                     m = re.match(pattern, path)
                     if m:
-                        self._send(200, handler(m))
+                        if method == "GET":
+                            self._respond_get(path, params, handler, m)
+                        else:
+                            self._send(200, handler(m))
                         return
                 self._send(404, {"message": f"no route {method} {path}"})
+
+            def _respond_get(self, path, params, handler, m):
+                """GET responses route through the anchored cache: a hit
+                skips the BeaconApi handler entirely; a miss serializes
+                once, stores body+ETag, and either path honours
+                If-None-Match with a bodyless 304."""
+                tier = outer.serving
+                key = None
+                if tier.config.cache_enabled:
+                    kind = classify_anchor("GET", path)
+                    if kind is not None:
+                        anchor = tier.anchor_for(kind)
+                        if anchor is not None:
+                            key = ResponseCache.key(
+                                path, params, kind, anchor
+                            )
+                if key is None:
+                    self._send(200, handler(m))
+                    return
+                inm = self.headers.get("If-None-Match")
+                entry = tier.cache.lookup(key)
+                if entry is not None:
+                    if inm is not None and inm == entry.etag:
+                        self._send_not_modified(entry.etag)
+                        return
+                    self._send(
+                        200,
+                        entry.body,
+                        entry.content_type,
+                        headers={"ETag": entry.etag, "X-Cache": "hit"},
+                    )
+                    return
+                body = json.dumps(handler(m)).encode()
+                etag = make_etag(body)
+                tier.cache.store(key, body, "application/json", etag)
+                if inm is not None and inm == etag:
+                    self._send_not_modified(etag)
+                    return
+                self._send(
+                    200,
+                    body,
+                    "application/json",
+                    headers={"ETag": etag, "X-Cache": "miss"},
+                )
+
+            def _send_not_modified(self, etag: str):
+                from ..utils import metrics as M
+
+                M.SERVING_NOT_MODIFIED.inc()
+                self.send_response(304)
+                self.send_header("ETag", etag)
+                self.send_header("Content-Length", "0")
+                self.end_headers()
+
+            def _stream_events(self, params):
+                """Live SSE: a bounded per-subscriber ring drained onto a
+                chunkless streaming response (Connection: close frames
+                the body by EOF). `limit=N` closes after N events — the
+                deterministic-test and curl-friendly escape hatch."""
+                topics = [
+                    t for t in params.get("topics", "").split(",") if t
+                ]
+                limit = (
+                    int(params["limit"]) if "limit" in params else None
+                )
+                sub = outer.serving.broadcaster.subscribe(topics or None)
+                if sub is None:
+                    raise ApiError(503, "SSE subscriber limit reached")
+                self.close_connection = True
+                self.send_response(200)
+                self.send_header("Content-Type", "text/event-stream")
+                self.send_header("Cache-Control", "no-cache")
+                self.send_header("Connection", "close")
+                self.end_headers()
+                sent = 0
+                idle_polls = 0
+                try:
+                    while True:
+                        ev = sub.pop(0.25)
+                        if ev is None:
+                            if sub.closed:
+                                break
+                            idle_polls += 1
+                            if idle_polls >= 40:
+                                # ~10s keepalive comment doubles as the
+                                # dead-socket probe freeing the slot
+                                self.wfile.write(b":keep-alive\n\n")
+                                self.wfile.flush()
+                                idle_polls = 0
+                            continue
+                        idle_polls = 0
+                        kind, payload = ev
+                        frame = (
+                            f"event: {kind}\n"
+                            f"data: {json.dumps(payload)}\n\n"
+                        )
+                        self.wfile.write(frame.encode())
+                        self.wfile.flush()
+                        sent += 1
+                        if limit is not None and sent >= limit:
+                            break
+                except (BrokenPipeError, ConnectionResetError, OSError):
+                    pass  # client went away; slot freed below
+                finally:
+                    outer.serving.broadcaster.unsubscribe(sub)
 
             def _body_fork(self):
                 body = self._body()
                 return body.get("version", "phase0") if body else "phase0"
 
         # cache request body between the two lambda reads in post_block
+        # (_route resets the memo per request so persistent connections
+        # never replay a previous request's body)
         orig_body = Handler._body
 
         def _body_cached(handler_self):
@@ -482,6 +657,9 @@ class BeaconApiServer:
         self._thread.start()
 
     def stop(self) -> None:
+        # wake every live SSE subscriber first so their handler threads
+        # exit their streams instead of blocking on the next pop
+        self.serving.close()
         self.server.shutdown()
         if self._thread:
             self._thread.join()

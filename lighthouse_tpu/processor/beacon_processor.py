@@ -276,6 +276,18 @@ class BeaconProcessor:
             self.handler_errors[name] = self.handler_errors.get(name, 0) + 1
             self.last_error = f"{name}: {type(exc).__name__}: {exc}"
 
+    def health_snapshot(self) -> dict:
+        """Point-in-time scheduling pressure, taken under the lock: the
+        serving tier's admission controller reads `pending` as a
+        shed signal; the rest rounds out the ops picture."""
+        with self._lock:
+            return {
+                "pending": sum(len(q) for q in self.queues.values()),
+                "dropped": sum(q.dropped for q in self.queues.values()),
+                "deferred": len(self._deferred),
+                "busy_workers": self._busy_workers,
+            }
+
     def _complete_deferred(self, block: bool) -> bool:
         """Resolve the OLDEST deferred batch (submit order). With
         block=False only if its device work already finished. Returns

@@ -242,12 +242,13 @@ def trace_health_fields(tracer=None) -> dict:
     return fields
 
 
-def beacon_node_source(chain) -> dict:
+def beacon_node_source(chain, serving=None) -> dict:
     """Chain-level fields for the beacon_node record (lib.rs:218-243),
-    plus the trace-derived health block (PR-5 follow-up)."""
+    plus the trace-derived health block (PR-5 follow-up) and — when a
+    serving tier is wired — its cache/SSE/admission counters."""
     head_root, head_state = chain.head()
     fin_epoch, _ = chain.finalized_checkpoint
-    return {
+    fields = {
         "slot": int(chain.current_slot),
         "head_slot": int(head_state.slot),
         "head_root": "0x" + bytes(head_root).hex(),
@@ -256,3 +257,6 @@ def beacon_node_source(chain) -> dict:
         "is_synced": int(chain.current_slot) <= int(head_state.slot) + 1,
         "health": trace_health_fields(),
     }
+    if serving is not None:
+        fields["serving"] = serving.stats()
+    return fields

@@ -1,0 +1,133 @@
+"""Deterministic serving-tier load generator: spins a real
+BeaconApiServer over an in-process chain (fake BLS backend), replays a
+seeded route mix twice — once with the response cache cleared before
+every request (the uncached/full-handler path) and once against a warm
+cache — and reports requests/s for both plus the tier's counters.
+
+Run via ``python bench.py --serving`` (one JSON line on stdout, CI
+artifact file via ``--out``) or directly::
+
+    JAX_PLATFORMS=cpu python -m tools.serving_load
+"""
+
+from __future__ import annotations
+
+import json
+import random
+import time
+import urllib.request
+
+# a read-heavy explorer/VC mix over cacheable anchored routes; the mix
+# is part of the benchmark's identity — change it and the numbers move
+ROUTES = [
+    "/eth/v1/beacon/genesis",
+    "/eth/v1/beacon/states/head/root",
+    "/eth/v1/beacon/states/head/fork",
+    "/eth/v1/beacon/states/head/validators",
+    "/eth/v1/beacon/states/finalized/finality_checkpoints",
+    "/eth/v1/beacon/states/head/committees",
+    "/eth/v2/beacon/blocks/head",
+    "/eth/v1/beacon/headers/head",
+    "/eth/v1/config/spec",
+    "/eth/v1/node/version",
+]
+
+
+def build_rig(validators: int = 16, slots: int = 8):
+    """(harness, server) over an ephemeral port, fake-crypto backend."""
+    from lighthouse_tpu.crypto.bls import set_backend
+
+    set_backend("fake")
+    from lighthouse_tpu.harness import BeaconChainHarness
+    from lighthouse_tpu.http_api import BeaconApi, BeaconApiServer
+    from lighthouse_tpu.types import MINIMAL, ChainSpec
+    from lighthouse_tpu.validator_client import InProcessBeaconNode
+
+    h = BeaconChainHarness(validators, MINIMAL, ChainSpec.interop())
+    h.extend_chain(slots)
+    node = InProcessBeaconNode(h.chain)
+    api = BeaconApi(node)
+    server = BeaconApiServer(api)
+    server.start()
+    return h, server
+
+
+def _sweep(base: str, order: list[str]) -> float:
+    t0 = time.monotonic()
+    for path in order:
+        with urllib.request.urlopen(base + path) as r:
+            r.read()
+    return time.monotonic() - t0
+
+
+def run(
+    requests: int = 200,
+    seed: int = 0,
+    validators: int = 16,
+    slots: int = 8,
+) -> dict:
+    h, server = build_rig(validators, slots)
+    tier = server.serving
+    base = f"http://127.0.0.1:{server.port}"
+    rng = random.Random(seed)
+    order = [rng.choice(ROUTES) for _ in range(requests)]
+    try:
+        # uncached: every request pays the full BeaconApi handler walk
+        t0 = time.monotonic()
+        for path in order:
+            tier.cache.clear()
+            with urllib.request.urlopen(base + path) as r:
+                r.read()
+        uncached_s = time.monotonic() - t0
+        # cached: one warm pass over the distinct routes, then measure
+        tier.cache.clear()
+        for path in sorted(set(order)):
+            with urllib.request.urlopen(base + path) as r:
+                r.read()
+        hits_before = tier.cache.hits
+        cached_s = _sweep(base, order)
+        hits = tier.cache.hits - hits_before
+    finally:
+        server.stop()
+    uncached_rps = requests / max(uncached_s, 1e-9)
+    cached_rps = requests / max(cached_s, 1e-9)
+    return {
+        "metric": "serving_cached_requests_per_s",
+        "value": round(cached_rps, 1),
+        "unit": "req/s",
+        "requests": requests,
+        "seed": seed,
+        "routes": len(ROUTES),
+        "uncached_rps": round(uncached_rps, 1),
+        "cached_rps": round(cached_rps, 1),
+        "speedup": round(cached_rps / max(uncached_rps, 1e-9), 2),
+        "cache_hits": hits,
+        "serving": tier.stats(),
+    }
+
+
+def main(argv=None) -> None:
+    import argparse
+
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("--requests", type=int, default=200)
+    ap.add_argument("--seed", type=int, default=0)
+    ap.add_argument("--validators", type=int, default=16)
+    ap.add_argument("--slots", type=int, default=8)
+    ap.add_argument("--out", default=None, help="also write JSON here")
+    args = ap.parse_args(argv)
+    result = run(
+        requests=args.requests,
+        seed=args.seed,
+        validators=args.validators,
+        slots=args.slots,
+    )
+    line = json.dumps(result)
+    print(line)
+    if args.out:
+        with open(args.out, "w", encoding="utf-8") as f:
+            f.write(line + "\n")
+
+
+if __name__ == "__main__":
+    main()

@@ -3,7 +3,7 @@
 PY ?= python
 
 .PHONY: lint lint-baseline test test-lint test-chaos test-crash \
-	test-scenario test-serving bench-serving
+	test-scenario test-serving test-kernels bench-serving warm-compile
 
 ## lint: AST consensus-safety & TPU-hazard pass (tools/lint, stdlib-only)
 lint:
@@ -45,6 +45,17 @@ test-serving:
 	JAX_PLATFORMS=cpu $(PY) -m pytest tests/test_serving.py -q \
 		-p no:cacheprovider
 
+## test-kernels: full Pallas kernel parity matrix incl. the slow fused
+## tower/Miller kernels in interpret mode (the CI kernels job)
+test-kernels:
+	JAX_PLATFORMS=cpu $(PY) -m pytest tests/test_pallas_kernels.py -q \
+		-p no:cacheprovider
+
 ## bench-serving: cached-vs-uncached requests/s (the CI serving job)
 bench-serving:
 	JAX_PLATFORMS=cpu $(PY) bench.py --serving --out bench-serving.json
+
+## warm-compile: AOT-compile every verifier shape bucket into ./datadir's
+## persistent compile cache (deploy-time warm pass; `cli warm`)
+warm-compile:
+	$(PY) -m lighthouse_tpu.cli warm --datadir $${DATADIR:-./datadir}

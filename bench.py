@@ -113,6 +113,16 @@ def orchestrate() -> None:
         return budget - (time.monotonic() - t_start)
 
     errors = []
+    timed_out = []
+
+    def run_phase(name: str, mode: str, env_extra: dict, timeout_s: float):
+        """_run_child plus phase-timeout bookkeeping: a phase that hits
+        its time box lands in `timed_out` (surfaced in the artifact) and
+        the orchestrator moves on -- partial results, never a dead run."""
+        ok, obj, err = _run_child(mode, env_extra, timeout_s)
+        if not ok and err and "timed out" in err:
+            timed_out.append(name)
+        return ok, obj, err
 
     # Phase 0: warm the CPU fallback BEFORE probing. BENCH_r05 starved:
     # six 75 s probes ate the window, then the cold fallback paid 70.8 s
@@ -128,8 +138,8 @@ def orchestrate() -> None:
             float(os.environ.get("BENCH_WARM_TIMEOUT_S", "300")),
             max(45.0, remaining() - 150.0),
         )
-        ok, warm, err = _run_child(
-            "child",
+        ok, warm, err = run_phase(
+            "warm", "child",
             {
                 "BENCH_PLATFORM": "cpu",
                 "BENCH_SETS": os.environ.get("BENCH_SETS_CPU", "16"),
@@ -163,8 +173,8 @@ def orchestrate() -> None:
         if attempt > 0 and elapsed + probe_timeout > probe_deadline:
             break
         attempt += 1
-        ok, info, err = _run_child(
-            "probe",
+        ok, info, err = run_phase(
+            f"probe#{attempt}", "probe",
             {},
             timeout_s=min(probe_timeout, max(20.0, remaining() - 20.0)),
         )
@@ -186,8 +196,8 @@ def orchestrate() -> None:
                 f"{int(remaining())}s left < child+fallback budget)"
             )
         else:
-            ok, result, err = _run_child(
-                "child",
+            ok, result, err = run_phase(
+                "tpu-run", "child",
                 {},
                 timeout_s=min(
                     max(120.0, remaining() - fallback_reserve),
@@ -208,8 +218,8 @@ def orchestrate() -> None:
         if warm is not None and warm.get("n_sets") == int(want_sets):
             result = warm
         else:
-            ok, result, err = _run_child(
-                "child",
+            ok, result, err = run_phase(
+                "cpu-run", "child",
                 {"BENCH_SETS": want_sets},
                 timeout_s=max(30.0, remaining() - 5.0),
             )
@@ -225,8 +235,8 @@ def orchestrate() -> None:
         if warm is not None:
             result = warm
         else:
-            ok, result, err = _run_child(
-                "child",
+            ok, result, err = run_phase(
+                "cpu-fallback", "child",
                 {
                     "BENCH_PLATFORM": "cpu",
                     # 16 sets: a shape kept warm in .jax_cache/cpu so the
@@ -250,6 +260,7 @@ def orchestrate() -> None:
                     "vs_baseline": 0.0,
                     "platform": platform or "none",
                     "error": "; ".join(errors) or "unknown",
+                    "timed_out": timed_out,
                 }
             )
         )
@@ -269,8 +280,8 @@ def orchestrate() -> None:
             env_extra = {}
             if result.get("platform") != "tpu":
                 env_extra["BENCH_PLATFORM"] = "cpu"
-            ok, prof, err = _run_child(
-                "profile", env_extra, timeout_s=prof_timeout
+            ok, prof, err = run_phase(
+                "profile", "profile", env_extra, timeout_s=prof_timeout
             )
             if ok:
                 result["mainnet_profile"] = prof
@@ -292,6 +303,8 @@ def orchestrate() -> None:
         _attach_last_tpu(result)
     if errors:
         result["error"] = "; ".join(errors)
+    if timed_out:
+        result["timed_out"] = timed_out
     _emit(result)
 
 
@@ -325,7 +338,10 @@ def child() -> None:
     from __graft_entry__ import _arm_compilation_cache, _example_batch
 
     _arm_compilation_cache()
-    from lighthouse_tpu.crypto.bls.backends.jax_tpu import verify_device
+    from lighthouse_tpu.crypto.bls.backends.jax_tpu import (
+        _bucket,
+        verify_device,
+    )
 
     t0 = time.perf_counter()
     args = _example_batch(n_sets, k_pk, distinct=distinct, dedup=True)
@@ -408,6 +424,20 @@ def child() -> None:
             "distinct_messages": min(distinct, n_sets),
             "fixture_s": round(fixture_s, 2),
             "compile_s": round(compile_s, 2),
+            # keyed by the dispatcher's bucketed shape (n x k x m x g;
+            # g=0 is the per-set path) -- the same names `cli warm`
+            # publishes on tpu_warm_compile_seconds
+            "compile_s_per_bucket": {
+                "x".join(
+                    str(v)
+                    for v in (
+                        _bucket(n_sets),
+                        _bucket(k_pk),
+                        _bucket(min(distinct, n_sets)),
+                        0,
+                    )
+                ): round(compile_s, 2)
+            },
             "steady_s": round(best, 4),
             "pipeline": {
                 "depth": int(M.BLS_PIPELINE_DEPTH.value),
@@ -450,6 +480,7 @@ def profile_child() -> None:
     _arm_compilation_cache()
     from lighthouse_tpu.crypto.bls.backends.jax_tpu import (
         _bucket,
+        grid_bucket,
         verify_device,
         verify_device_aggregated,
     )
@@ -510,6 +541,23 @@ def profile_child() -> None:
             "compile_s": {
                 "unaggregated": round(unagg_compile, 2),
                 "aggregated": round(agg_compile, 2),
+            },
+            # per-bucket compile wall next to the sets/s numbers, keyed
+            # like the warm pass (n x k x m x g)
+            "compile_s_per_bucket": {
+                "x".join(
+                    str(v)
+                    for v in (_bucket(n), _bucket(k), _bucket(d), 0)
+                ): round(unagg_compile, 2),
+                "x".join(
+                    str(v)
+                    for v in (
+                        _bucket(n),
+                        _bucket(k),
+                        _bucket(d),
+                        grid_bucket(_bucket(n)),
+                    )
+                ): round(agg_compile, 2),
             },
         }
     )

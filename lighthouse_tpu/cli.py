@@ -303,6 +303,16 @@ def cmd_bn(args):
         service="bn"
     )
     node, server = build_beacon_node(args)
+    if getattr(args, "warm_compile", False):
+        # warm BEFORE serving: every bucketed verifier shape compiles (or
+        # loads from the armed datadir cache) now, so the first slot's
+        # batches hit only warm executables
+        from .crypto.bls.backends.jax_tpu import warm_compile
+
+        for row in warm_compile():
+            log.info("warm bucket", bucket="x".join(
+                str(v) for v in row["bucket"]
+            ), seconds=round(row["seconds"], 3), compiled=row["compiled"])
     server.start()
     log.info("beacon node started", http_port=server.port,
              validators=len(node.chain.head_state.validators))
@@ -792,6 +802,43 @@ def cmd_tools(args):
     return 0
 
 
+def cmd_warm(args):
+    """Standalone AOT bucket warm-up (deploy step): arm the persistent
+    compile cache under the datadir and compile every verifier shape
+    bucket into it, so the NEXT process (the node) starts fully warm --
+    zero tpu_compile_cache_misses_total during slots."""
+    import os
+
+    from .crypto.bls.backends.jax_tpu import warm_compile
+
+    if args.datadir:
+        from .utils.compile_cache import arm as _arm_compile_cache
+
+        _arm_compile_cache(os.path.join(args.datadir, "compile_cache"))
+
+    buckets = None
+    if args.bucket:
+        buckets = []
+        for spec in args.bucket:
+            parts = tuple(int(v) for v in spec.split(","))
+            if len(parts) != 3:
+                print(f"bad --bucket {spec!r}: want n_b,k_b,m_b")
+                return 2
+            buckets.append(parts)
+
+    report = warm_compile(buckets=buckets)
+    compiled = sum(1 for row in report if row["compiled"])
+    for row in report:
+        name = "x".join(str(v) for v in row["bucket"])
+        state = "compiled" if row["compiled"] else "warm"
+        print(f"{name:>16}  {row['seconds']:8.3f}s  {state}")
+    print(
+        f"{len(report)} buckets ({compiled} compiled, "
+        f"{len(report) - compiled} already warm)"
+    )
+    return 0
+
+
 def main(argv=None) -> int:
     parser = argparse.ArgumentParser(prog="lighthouse-tpu")
     sub = parser.add_subparsers(dest="cmd", required=True)
@@ -828,6 +875,10 @@ def main(argv=None) -> int:
                     help="push process/system/chain health JSON here "
                     "(common/monitoring_api parity)")
     bn.add_argument("--dry-run", action="store_true")
+    bn.add_argument("--warm-compile", action="store_true",
+                    help="AOT-compile every verifier shape bucket before "
+                         "serving (cli warm, inline): a fresh node never "
+                         "JITs during a slot")
     bn.add_argument("--processor-workers", type=int, default=1,
                     help="gossip worker pool size (beacon_processor)")
     bn.add_argument("--serving-no-cache", action="store_true",
@@ -948,6 +999,22 @@ def main(argv=None) -> int:
     scen.add_argument("--out", default=None,
                       help="write the Chrome trace-event JSON here")
     scen.set_defaults(fn=cmd_scenario)
+
+    warm = sub.add_parser(
+        "warm",
+        help="AOT-compile every verifier shape bucket into the datadir's "
+             "persistent compile cache (deploy-time warm pass)",
+    )
+    warm.add_argument("--datadir", default=None,
+                      help="arm the persistent compile cache under this "
+                           "datadir (same location `bn` uses); omit for "
+                           "an in-process-only warm")
+    warm.add_argument("--bucket", action="append", default=None,
+                      metavar="N,K,M",
+                      help="bucketed (sets, pubkeys, messages) shape to "
+                           "warm; repeatable; default is the built-in "
+                           "steady-state set")
+    warm.set_defaults(fn=cmd_warm)
 
     tools = sub.add_parser("tools", help="dev tools (lcli)")
     _add_network_args(tools)

@@ -25,6 +25,7 @@ indices + messages + signatures only.
 from __future__ import annotations
 
 import os
+import time
 from dataclasses import dataclass
 
 import numpy as np
@@ -184,6 +185,83 @@ def verify_body(u, pk_jac, sig_jac, scalars, real, axis_name=None):
 verify_jit = jax.jit(verify_body)
 
 
+def verify_body_grouped(
+    u, pk_jac, sig_jac, scalars, real, member, msg_real, axis_name=None
+):
+    """The batch-verify computation with the PER-MESSAGE group reduction,
+    shardable across a device mesh.
+
+    The mega-pairing identity (crypto/bls/aggregation.py) collapses every
+    set sharing a message into one Miller pair; `verify_device_aggregated`
+    exploits it on a single chip via the gather grid. This body is its
+    multi-chip form: the per-set arrays (pk/sig/scalars/real) and the
+    (n, m) membership mask shard on the sets axis; each shard reduces its
+    LOCAL weighted per-set pubkeys into per-message PARTIAL sums (a
+    masked broadcast + one scanned halving body), one all_gather ships
+    the m_b partial points (tiny: m_b is a handful) and every chip sums
+    them into the full per-message pubkeys. The m_b + 1 Miller pairs then
+    run REPLICATED on every chip -- at mega-batch sizes the per-set work
+    (ladders, subgroup checks) dominates and m_b + 1 pairs are cheaper
+    than a second collective round for fprod, so sharded mega-batches pay
+    ~m Miller pairs instead of ~n.
+
+    `u` holds the batch's DISTINCT message draws, `msg_real` masks padded
+    message rows; both are replicated. Verdicts are bit-identical to the
+    single-device aggregated path for the same weights.
+    """
+    # per-set prep: identical to verify_body
+    agg_pk = _sum_points(jnp.moveaxis(pk_jac, 1, 0), TC.FP)
+    agg_pk_bad = TC.is_infinity(agg_pk, TC.FP) & real
+    sig_ok = TC.g2_subgroup_check(sig_jac)
+
+    # distinct-message mapping H(m): replicated, m_b rows
+    h = THC.map_to_g2(u)
+    h_aff, h_inf = TC.to_affine_g2(h)
+
+    # weight ladder, kept PROJECTIVE for the group sums
+    rpk = TC.scalar_mul_u64(agg_pk, scalars, TC.FP)
+
+    # local per-message partial sums: mask each set's weighted pubkey into
+    # its message row (non-members -> infinity), then one halving body
+    inf_g1 = TC.infinity(TC.FP)
+    rows = jnp.where(
+        jnp.moveaxis(member, 0, -1)[..., None, None], rpk[None], inf_g1
+    )  # (m_b, n_loc, 3, W)
+    part = _sum_points(jnp.moveaxis(rows, 1, 0), TC.FP)  # (m_b, 3, W)
+    if axis_name is not None:
+        # (shards, m_b, 3, W) -> full per-message pubkeys on every chip
+        part = _sum_points(
+            jax.lax.all_gather(part, axis_name, axis=0), TC.FP
+        )
+    gpk_aff, gpk_inf = TC.to_affine_g1(part)
+
+    rsig = TC.scalar_mul_u64(sig_jac, scalars, TC.FP2)
+    ssum = _sum_points(rsig, TC.FP2)
+    if axis_name is not None:
+        ssum = _sum_points(
+            jax.lax.all_gather(ssum, axis_name, axis=0), TC.FP2
+        )
+    ssum_aff, ssum_inf = TC.to_affine_g2(ssum[None])
+
+    # m_b + 1 pairs, replicated: every chip computes the SAME product, so
+    # no fprod collective is needed (padded message rows are already
+    # infinity partial sums; ~msg_real is belt-and-braces)
+    p_aff = jnp.concatenate([gpk_aff, _neg_g1_gen_aff()[None]], axis=0)
+    p_inf = jnp.concatenate([gpk_inf | ~msg_real, jnp.zeros((1,), bool)], axis=0)
+    q_aff = jnp.concatenate([h_aff, ssum_aff], axis=0)
+    q_inf = jnp.concatenate([h_inf, ssum_inf], axis=0)
+    ok = TP.multi_pairing_is_one(p_aff, p_inf, q_aff, q_inf)
+
+    valid = ok & jnp.all(sig_ok) & ~jnp.any(agg_pk_bad)
+    if axis_name is not None:
+        valid = jnp.all(jax.lax.all_gather(valid, axis_name))
+    return valid
+
+
+# the grouped monolith for a mesh of one (the single-chip survivor path)
+verify_grouped_jit = jax.jit(verify_body_grouped)
+
+
 # --- staged pipeline --------------------------------------------------------
 #
 # The monolithic verify_body is ONE very large XLA program. On the remote-TPU
@@ -326,6 +404,18 @@ def _bucket(n: int, floor: int = 4) -> int:
     return b
 
 
+def grid_bucket(n_b: int) -> int:
+    """Aggregation-grid group-axis bucket: PINNED to the set bucket. A
+    message can have at most n <= n_b member sets, so an (m_b, n_b) grid
+    always fits every grouping; pinning removes the traffic-dependent
+    max-group axis from the shape space entirely. The compile-shape key
+    collapses from (n_b, k_b, m_b, g_b ~ traffic) to the fixed family
+    (n_b, k_b, m_b) -- which is what makes the exhaustive deploy-time
+    warm pass (`warm_compile` / `cli warm`) possible: a fresh node can
+    enumerate and pre-compile EVERY shape it will ever see."""
+    return n_b
+
+
 def _common_table(sets):
     """The shared pubkey table if EVERY pubkey in the batch is tagged with
     the same one (by the chain's ValidatorPubkeyCache), else None."""
@@ -395,6 +485,8 @@ class Marshalled:
     real: object
     grid_idx: object  # (m_b, g_b) int32 device array, or None
     grid_real: object  # (m_b, g_b) bool device array, or None
+    member: object  # (n_b, m_b) bool membership mask (grouped mesh), or None
+    msg_real: object  # (m_b,) bool real-message mask (grouped mesh), or None
     n_sets: int
     n_messages: int
     # shape key to register as compiled once dispatch returns (None when
@@ -456,17 +548,28 @@ def _marshal_batch(sets, seed=None, groups=None):
     for i, s in enumerate(sets):
         sig[i] = _sig_limbs(s.signature)
 
-    # Aggregation grid: only when grouping actually collapses BUCKETED
+    # Aggregation layout: only when grouping actually collapses BUCKETED
     # pairs (m_b < n_b -- the Miller stage runs at bucketed shapes, so
     # m < n inside the same power-of-two bucket would pay the group
-    # reduction and a fresh compile shape for zero pair savings) and the
-    # batch stays on the single-chip staged path -- the mesh shards the
-    # per-set axis and keeps the per-set layout.
+    # reduction and a fresh compile shape for zero pair savings). The
+    # group axis is PINNED to n_b (grid_bucket) so the shape family stays
+    # enumerable for the warm pass. Single-chip batches take the gather
+    # grid (verify_device_aggregated); mesh-eligible batches instead ship
+    # an (n_b, m_b) membership mask that SHARDS with the sets axis -- the
+    # grouped mesh body reduces per-message pubkey partial sums per shard
+    # and all-gathers m_b points, paying ~m Miller pairs instead of ~n.
     grid_idx = grid_real = None
+    member = msg_real = None
     g_b = 0
-    if _msg_agg_enabled() and m_b < n_b and not _mesh_eligible(n_b):
-        g_b = _bucket(groups.max_group())
-        grid_idx, grid_real = AG.group_grid(groups.members, m_b, g_b)
+    if _msg_agg_enabled() and m_b < n_b:
+        g_b = grid_bucket(n_b)
+        if _mesh_eligible(n_b):
+            member = np.zeros((n_b, m_b), bool)
+            member[np.arange(n), groups.set_message] = True
+            msg_real = np.zeros((m_b,), bool)
+            msg_real[:m] = True
+        else:
+            grid_idx, grid_real = AG.group_grid(groups.members, m_b, g_b)
 
     table = _common_table(sets)
     new_shape_key = _count_shape_bucket(n_b, k_b, m_b, g_b)
@@ -506,7 +609,11 @@ def _marshal_batch(sets, seed=None, groups=None):
     real = np.zeros((n_b,), bool)
     real[:n] = True
     grid_traffic = () if grid_idx is None else (grid_idx, grid_real)
-    _count_transfer(u, h_idx, sig, scalars, real, *grid_traffic, *pk_traffic)
+    group_traffic = () if member is None else (member, msg_real)
+    _count_transfer(
+        u, h_idx, sig, scalars, real,
+        *grid_traffic, *group_traffic, *pk_traffic,
+    )
 
     return Marshalled(
         u=jnp.asarray(u),
@@ -517,6 +624,8 @@ def _marshal_batch(sets, seed=None, groups=None):
         real=jnp.asarray(real),
         grid_idx=None if grid_idx is None else jnp.asarray(grid_idx),
         grid_real=None if grid_real is None else jnp.asarray(grid_real),
+        member=None if member is None else jnp.asarray(member),
+        msg_real=None if msg_real is None else jnp.asarray(msg_real),
         n_sets=n,
         n_messages=m,
         new_shape_key=new_shape_key,
@@ -576,14 +685,27 @@ def dispatch_verify_signature_sets(sets, seed=None, groups=None):
             # mesh; a chip fault shrinks the mesh over survivors (per-
             # device breakers) and raises MeshEmpty only when no device
             # is usable -- which the FallbackBackend degrades to the cpu
-            # oracle.
-            _count_pairs(mb.n_sets, n_b + 1, aggregated=False)
-            out = _mesh_verifier().verify(
-                (
-                    jnp.take(mb.u, mb.h_idx, axis=0),
-                    mb.pk, mb.sig, mb.scalars, mb.real,
+            # oracle. When marshalling built the membership mask the mesh
+            # runs the GROUPED body: sharded mega-batches pay ~m Miller
+            # pairs instead of ~n.
+            if mb.member is not None:
+                _count_pairs(
+                    mb.n_sets, int(mb.u.shape[0]) + 1, aggregated=True
                 )
-            )
+                out = _mesh_verifier().verify(
+                    (
+                        mb.u, mb.pk, mb.sig, mb.scalars, mb.real,
+                        mb.member, mb.msg_real,
+                    )
+                )
+            else:
+                _count_pairs(mb.n_sets, n_b + 1, aggregated=False)
+                out = _mesh_verifier().verify(
+                    (
+                        jnp.take(mb.u, mb.h_idx, axis=0),
+                        mb.pk, mb.sig, mb.scalars, mb.real,
+                    )
+                )
         elif os.environ.get("LIGHTHOUSE_TPU_MONOLITH") == "1":
             # the monolithic program takes per-set draws (no dedup axis)
             _count_pairs(mb.n_sets, n_b + 1, aggregated=False)
@@ -613,6 +735,85 @@ def dispatch_verify_signature_sets(sets, seed=None, groups=None):
 
 def verify_signature_sets(sets, seed=None) -> bool:
     return bool(dispatch_verify_signature_sets(sets, seed=seed))
+
+
+# The shape families a fresh node sees in steady state: gossip batches
+# (<= 64 sets, mostly distinct messages -> m_b == n_b, per-set staged
+# path) and aggregate/backfill mega-batches (repeated messages -> m_b
+# collapsed to the floor, aggregated path). k_b stays at the bucket
+# floor for the dominant 1-pubkey sets; operators with heavier committee
+# shapes pass their own bucket list to `warm_compile`.
+DEFAULT_WARM_BUCKETS: tuple = tuple(
+    sorted({(n_b, 4, m_b) for n_b in (4, 16, 64, 256) for m_b in (4, n_b)})
+)
+
+
+def warm_compile(buckets=None, runner=None):
+    """AOT bucket warm-up: compile (or load from the armed persistent
+    cache) the backend executables for every shape bucket in `buckets`,
+    so a fresh node never JITs during a slot.
+
+    Each (n_b, k_b, m_b) bucket drives the SAME jitted entry points the
+    dispatcher routes to -- the aggregated grid path when message
+    aggregation is on and m_b < n_b, else the per-set staged path --
+    with structurally-valid all-padding batches (XLA compilation is
+    shape-keyed; values are irrelevant: padded rows hold projective
+    infinities and zero scalars exactly like real padding). Shapes are
+    scored and registered exactly like dispatched batches: cold shapes
+    count on tpu_compile_cache_misses_total and land in the persistent
+    registry after the executable exists, warm ones count hits. Per-
+    bucket wall seconds are published on tpu_warm_compile_seconds (and
+    returned) so deploys can budget the pass.
+
+    `runner` is injectable for tests: called as runner(kind, args) with
+    kind in {"staged", "aggregated"}; the default drives the real
+    executables and blocks until compile + run complete. Returns a list
+    of {"bucket", "seconds", "compiled"} dicts.
+    """
+    if buckets is None:
+        buckets = DEFAULT_WARM_BUCKETS
+    if runner is None:
+        def runner(kind, args):
+            if kind == "aggregated":
+                out = verify_device_aggregated(*args)
+            else:
+                out = verify_device(*args)
+            jax.block_until_ready(out)
+
+    report = []
+    for n_b, k_b, m_b in buckets:
+        aggregated = _msg_agg_enabled() and m_b < n_b
+        g_b = grid_bucket(n_b) if aggregated else 0
+        u = jnp.zeros((m_b, 2, 2, W), jnp.int32)
+        pk = jnp.broadcast_to(
+            jnp.asarray(_INF_G1), (n_b, k_b, 3, W)
+        ).astype(jnp.int32)
+        sig = jnp.zeros((n_b, 3, 2, W), jnp.int32).at[:, 1, 0, 0].set(1)
+        scalars = jnp.zeros((n_b, 2), jnp.uint32)
+        real = jnp.zeros((n_b,), bool)
+        new_key = _count_shape_bucket(n_b, k_b, m_b, g_b)
+        t0 = time.monotonic()
+        if aggregated:
+            grid_idx = jnp.zeros((m_b, g_b), jnp.int32)
+            grid_real = jnp.zeros((m_b, g_b), bool)
+            runner(
+                "aggregated",
+                (u, pk, sig, scalars, real, grid_idx, grid_real),
+            )
+        else:
+            h_idx = jnp.zeros((n_b,), jnp.int32)
+            runner("staged", (u, h_idx, pk, sig, scalars, real))
+        seconds = time.monotonic() - t0
+        if new_key is not None:
+            compile_cache.record_shape(new_key)
+        key = (n_b, k_b, m_b, g_b)
+        metrics.TPU_WARM_COMPILE_SECONDS.set(
+            "x".join(str(v) for v in key), seconds
+        )
+        report.append(
+            {"bucket": key, "seconds": seconds, "compiled": new_key is not None}
+        )
+    return report
 
 
 @jax.jit

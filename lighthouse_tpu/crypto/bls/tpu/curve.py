@@ -39,6 +39,8 @@ tests/test_tpu_curve.py.
 
 from __future__ import annotations
 
+import os as _os
+
 import numpy as np
 
 import jax
@@ -262,6 +264,62 @@ def scalar_mul_u64(p, scalars, F):
     init = infinity(F, p.shape[: p.ndim - F.coord_ndim - 1])
     out, _ = jax.lax.scan(body, init, bits)
     return out
+
+
+def scalar_mul_u64_windowed(p, scalars, F, window: int = 4):
+    """[s]P via a fixed-window ladder: a 2^window-entry point table built
+    once (2^window - 2 sequential adds), then 64/window scan steps of
+    `window` doublings + ONE table-gathered add -- 16 adds instead of 64
+    for the default 4-bit window.
+
+    This is the ladder the NOTE above reverted from the default XLA path
+    (commit 3ef20a6: table build + in-scan gathers blew the 8-device SPMD
+    compile past the budget). It is re-tried ONLY under the Pallas flag,
+    where the fused point/field kernels collapse each add/double to a
+    handful of pallas_call ops and hand tiling, not XLA fusion search,
+    controls program size. The complete projective group law makes the
+    table's infinity entry and windows of zero digits exception-free, so
+    no select is needed around the add."""
+    batch = p.shape[: p.ndim - F.coord_ndim - 1]
+    hi = scalars[..., 0]
+    lo = scalars[..., 1]
+    word = jnp.stack([hi, lo], axis=0)  # (2, ...)
+    per_word = 32 // window
+    ndigits = 64 // window
+    assert 64 % window == 0 and 32 % window == 0
+
+    def digit_at(k):  # k in [0, ndigits), MSB first
+        w = word[k // per_word]
+        shift = jnp.uint32(32 - window * (k % per_word + 1))
+        return ((w >> shift) & jnp.uint32((1 << window) - 1)).astype(jnp.int32)
+
+    digits = jnp.stack([digit_at(k) for k in range(ndigits)], axis=0)
+
+    # table[j] = [j]P, built with sequential complete adds (the unrolled
+    # construction the XLA path could not afford)
+    tbl = [infinity(F, batch), p]
+    for _ in range(2, 1 << window):
+        tbl.append(add(tbl[-1], p, F))
+    table = jnp.stack(tbl, axis=0)  # (2^window,) + batch + point dims
+
+    def gather(digit):
+        idx = digit.reshape((1,) + digit.shape + (1,) * (F.coord_ndim + 1))
+        return jnp.take_along_axis(table, idx, axis=0)[0]
+
+    def body(acc, digit):
+        for _ in range(window):
+            acc = double(acc, F)
+        return add(acc, gather(digit), F), None
+
+    out, _ = jax.lax.scan(body, gather(digits[0]), digits[1:])
+    return out
+
+
+if _os.environ.get("LIGHTHOUSE_TPU_PALLAS") == "1":  # pragma: no cover
+    _scalar_mul_u64_bit = scalar_mul_u64
+
+    def scalar_mul_u64(p, scalars, F):  # noqa: F811
+        return scalar_mul_u64_windowed(p, scalars, F)
 
 
 # --- cross-set reductions ---------------------------------------------------

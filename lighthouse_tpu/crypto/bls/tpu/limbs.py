@@ -186,10 +186,12 @@ def sq(a: jnp.ndarray) -> jnp.ndarray:
 # Optional fused Pallas path (pallas_kernels.py): same math in one kernel
 # per block. Opt-in -- the XLA formulation above measured fastest on v5e,
 # so the switch exists for per-generation tuning, not as the default.
-# COVERAGE: only plain Fp mul/sq switch; the Fp2 Karatsuba in tower.py
-# keeps the XLA column path deliberately (its column-domain sharing adds
-# three raw column vectors BEFORE one reduction -- a fused mul-with-
-# reduction kernel cannot express that without giving the sharing up).
+# COVERAGE: plain Fp mul/sq switch here; tower.py switches its fused
+# Fp6/Fp12 multiplies and the cyclotomic square, and pairing.py its fused
+# Miller-loop steps, under the same flag. The Fp2 Karatsuba used by
+# remaining XLA call sites keeps the column path (its column-domain
+# sharing adds three raw column vectors BEFORE one reduction); the fused
+# kernels express the same sharing INSIDE the kernel body.
 import os as _os  # noqa: E402
 
 if _os.environ.get("LIGHTHOUSE_TPU_PALLAS") == "1":  # pragma: no cover
@@ -199,9 +201,9 @@ if _os.environ.get("LIGHTHOUSE_TPU_PALLAS") == "1":  # pragma: no cover
         return fp_mul(a, b)
 
     def sq(a: jnp.ndarray) -> jnp.ndarray:  # noqa: F811
-        from .pallas_kernels import fp_mul
+        from .pallas_kernels import fp_sq
 
-        return fp_mul(a, a)
+        return fp_sq(a)
 
 
 def _norm(x: jnp.ndarray) -> jnp.ndarray:

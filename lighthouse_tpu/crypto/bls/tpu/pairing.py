@@ -40,6 +40,8 @@ Differentially tested against pairing_ref.py in tests/test_tpu_pairing.py.
 
 from __future__ import annotations
 
+import os as _os
+
 import numpy as np
 
 import jax
@@ -48,6 +50,16 @@ import jax.numpy as jnp
 from ..constants import BLS_X, P, R
 from . import limbs as L
 from . import tower as T
+
+# Opt-in fused Pallas Miller steps (pallas_kernels.py): the whole scan
+# body -- f^2, the Jacobian point step, the line evaluation, and the
+# sparse mul_by_line update -- runs as ONE kernel per step, bit-identical
+# to the lax composition below. (T.fp12_cyclotomic_sq and T.fp12_mul used
+# by the final exponentiation are rebound inside tower.py under the same
+# flag.)
+_USE_PALLAS = _os.environ.get("LIGHTHOUSE_TPU_PALLAS") == "1"
+if _USE_PALLAS:  # pragma: no cover
+    from . import pallas_kernels as PK
 
 W = L.W
 _X_ABS = -BLS_X
@@ -193,11 +205,16 @@ def miller_loop(p_aff, p_inf, q_aff, q_inf):
 
     def body(carry, bit):
         f, t = carry
-        t, line = _dbl_step(t, xp, yp)
-        f = mul_by_line(T.fp12_sq(f), line)
+        if _USE_PALLAS:  # pragma: no cover - interpret-mode parity in CI
+            f, t = PK.miller_dbl_step(f, t, xp, yp)
+        else:
+            t, line = _dbl_step(t, xp, yp)
+            f = mul_by_line(T.fp12_sq(f), line)
 
         def with_add(args):
             f_, t_ = args
+            if _USE_PALLAS:  # pragma: no cover
+                return PK.miller_add_step(f_, t_, q_aff, xp, yp)
             t2, line2 = _add_step(t_, q_aff, xp, yp)
             return mul_by_line(f_, line2), t2
 

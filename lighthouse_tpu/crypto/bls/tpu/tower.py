@@ -498,3 +498,29 @@ def fp12_frobenius_n(a, n: int):
     for _ in range(n):
         a = fp12_frobenius(a)
     return a
+
+
+# Optional fused Pallas path: the hot tower multiplies and the cyclotomic
+# square switch to single fused kernels (pallas_kernels.py) under the same
+# opt-in flag as limbs.mul/sq. The kernels transcribe the formulas above
+# bit-for-bit (same column sharing, same reduction schedule), so every
+# rebind is output-identical to the XLA path it replaces. Placed at module
+# bottom: earlier definitions resolve these names at CALL time, so e.g.
+# fp12_inv's fp6_mul calls route through the kernel too.
+import os as _os  # noqa: E402
+
+if _os.environ.get("LIGHTHOUSE_TPU_PALLAS") == "1":  # pragma: no cover
+    def fp6_mul(a, b):  # noqa: F811
+        from .pallas_kernels import fp6_mul as _pk_fp6_mul
+
+        return _pk_fp6_mul(a, b)
+
+    def fp12_mul(a, b):  # noqa: F811
+        from .pallas_kernels import fp12_mul as _pk_fp12_mul
+
+        return _pk_fp12_mul(a, b)
+
+    def fp12_cyclotomic_sq(a):  # noqa: F811
+        from .pallas_kernels import fp12_cyclotomic_sq as _pk_cyclo_sq
+
+        return _pk_cyclo_sq(a)

@@ -33,7 +33,12 @@ except ImportError:  # pre-0.6 jax: the experimental namespace. The
 
     SHARD_MAP_NATIVE = False
 
-from ..crypto.bls.backends.jax_tpu import verify_body, verify_jit
+from ..crypto.bls.backends.jax_tpu import (
+    verify_body,
+    verify_body_grouped,
+    verify_grouped_jit,
+    verify_jit,
+)
 from ..resilience.primitives import CircuitBreaker, EventLog
 from ..utils import metrics, tracing
 
@@ -66,6 +71,42 @@ def make_sharded_verify(mesh: Mesh):
     return jax.jit(body)
 
 
+def make_sharded_verify_grouped(mesh: Mesh):
+    """The GROUPED (mega-pairing) sharded verifier over `mesh`: per-set
+    arrays and the (n, m) membership mask shard on the sets axis, while
+    the distinct-message draws and real-message mask replicate -- the
+    layout `verify_body_grouped` expects. Each shard reduces its local
+    per-message pubkey partial sums; one all_gather of m tiny G1 points
+    completes them, so the batch pays ~m Miller pairs instead of ~n.
+
+    The returned callable carries ``arg_specs`` (one PartitionSpec per
+    argument) so :class:`DeviceExecutor` can place the mixed
+    sharded/replicated argument list correctly."""
+
+    spec = P(AXIS)
+    rep = P()
+    specs = (rep, spec, spec, spec, spec, spec, rep)
+
+    def shard_fn(u, pk, sig, r, real, member, msg_real):
+        return verify_body_grouped(
+            u, pk, sig, r, real, member, msg_real, axis_name=AXIS
+        )
+
+    kw = dict(mesh=mesh, in_specs=specs, out_specs=rep)
+    try:
+        body = shard_map(shard_fn, check_vma=False, **kw)
+    except TypeError:  # pre-0.6 jax spells the flag check_rep
+        body = shard_map(shard_fn, check_rep=False, **kw)
+    fn = jax.jit(body)
+
+    # a plain wrapper because jit objects reject attribute assignment
+    def call(*args):
+        return fn(*args)
+
+    call.arg_specs = specs
+    return call
+
+
 # -- the resilient mesh (per-device breakers; ROADMAP pmap open item) --------
 
 
@@ -86,8 +127,14 @@ class DeviceExecutor:
         if len(devices) == 1:
             placed = tuple(jax.device_put(a, devices[0]) for a in args)
         else:
-            sharding = NamedSharding(sets_mesh(devices), P(AXIS))
-            placed = tuple(jax.device_put(a, sharding) for a in args)
+            # per-set programs shard every arg; grouped programs publish
+            # per-arg specs (replicated message draws + sharded masks)
+            specs = getattr(fn, "arg_specs", None) or (P(AXIS),) * len(args)
+            mesh = sets_mesh(devices)
+            placed = tuple(
+                jax.device_put(a, NamedSharding(mesh, s))
+                for a, s in zip(args, specs)
+            )
         return fn(*placed)
 
 
@@ -161,6 +208,7 @@ class MeshVerifier:
         executor=None,
         prober=None,
         program_factory=None,
+        grouped_program_factory=None,
     ):
         self.devices = (
             list(jax.devices()) if devices is None else list(devices)
@@ -172,6 +220,9 @@ class MeshVerifier:
         # unit tests never touch shard_map/Mesh
         self.program_factory = program_factory or (
             lambda devs: make_sharded_verify(sets_mesh(list(devs)))
+        )
+        self.grouped_program_factory = grouped_program_factory or (
+            lambda devs: make_sharded_verify_grouped(sets_mesh(list(devs)))
         )
         if breaker_factory is None:
             # clock-free: after `denied_budget` skipped batches the lost
@@ -240,18 +291,34 @@ class MeshVerifier:
             size *= 2
         return devices[:size]
 
-    def _program(self, mesh_devices: tuple):
-        key = tuple(d.id for d in mesh_devices)
+    def _program(self, mesh_devices: tuple, grouped: bool = False):
+        key = (("grouped",) if grouped else ()) + tuple(
+            d.id for d in mesh_devices
+        )
         fn = self._compiled.get(key)
         if fn is None:
-            fn = self._compiled[key] = self.program_factory(mesh_devices)
+            factory = (
+                self.grouped_program_factory
+                if grouped
+                else self.program_factory
+            )
+            fn = self._compiled[key] = factory(mesh_devices)
         return fn
+
+    @staticmethod
+    def _n_sets(args) -> int:
+        """The batch's bucketed set count: `real`'s length. The grouped
+        7-arg layout carries it at position 4 (the trailing args are the
+        membership and message masks); the per-set 5-arg layout last."""
+        return int(args[4].shape[0] if len(args) == 7 else args[-1].shape[0])
 
     # -- verification --------------------------------------------------------
 
     def verify(self, args):
         """One batch over the current mesh: `args` is the 5-tuple of
-        globally-shaped per-set arrays (u, pk, sig, scalars, real).
+        globally-shaped per-set arrays (u, pk, sig, scalars, real), or
+        the grouped 7-tuple (u, pk, sig, scalars, real, member,
+        msg_real) for the per-message group reduction.
         Dispatches the device work NOW and returns a :class:`MeshVerdict`
         whose ``bool()`` materialises the answer -- JAX surfaces
         execution faults at materialisation, not dispatch, so breaker
@@ -259,7 +326,7 @@ class MeshVerifier:
         (a fault at either point re-shards the SAME batch over the
         surviving devices before answering). Raises MeshEmpty when no
         device remains."""
-        n_sets = int(args[-1].shape[0])
+        n_sets = self._n_sets(args)
         mesh_devs = self._select_mesh(n_sets)
         if not mesh_devs:
             raise MeshEmpty(
@@ -284,11 +351,11 @@ class MeshVerifier:
         # a mesh of one runs the plain single-chip program: same
         # computation, no shard_map/collective overhead, and the
         # "survivor" path is literally the single-chip path
-        fn = (
-            verify_jit
-            if len(mesh_devs) == 1
-            else self._program(tuple(mesh_devs))
-        )
+        grouped = len(args) == 7
+        if len(mesh_devs) == 1:
+            fn = verify_grouped_jit if grouped else verify_jit
+        else:
+            fn = self._program(tuple(mesh_devs), grouped)
         with self.tracer().span("mesh_dispatch", devices=len(mesh_devs)):
             return self.executor.run(fn, args, mesh_devs)
 
@@ -323,7 +390,7 @@ class MeshVerifier:
         rounds are bounded by the device count: recovery probes belong
         to FUTURE batches, so one batch can never spin on a mesh whose
         breakers keep maturing mid-call."""
-        n_sets = int(args[-1].shape[0])
+        n_sets = self._n_sets(args)
         # lint: allow[retry-no-backoff] -- not a retry of the same
         # resource: each round runs on a DIFFERENT (shrunken) mesh, and
         # waiting out a backoff would stall consensus on a healthy

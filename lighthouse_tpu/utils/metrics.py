@@ -497,6 +497,12 @@ TPU_COMPILE_CACHE_MISSES = REGISTRY.counter(
     "tpu_compile_cache_misses_total",
     "Batches marshalled to a NEW bucketed shape (XLA compile expected)",
 )
+TPU_WARM_COMPILE_SECONDS = REGISTRY.labeled_gauge(
+    "tpu_warm_compile_seconds",
+    "Wall seconds the AOT warm pass spent compiling (or cache-loading) "
+    "each shape bucket's backend executables",
+    label="bucket",
+)
 TPU_TRANSFER_BYTES = REGISTRY.counter(
     "tpu_transfer_bytes_total",
     "Host-to-device bytes marshalled for verification batches",

@@ -64,6 +64,13 @@ def pytest_configure(config):
         "(partitions/churn/storms/non-finality/crash-recovery); the "
         "dedicated scenario CI job runs the full matrix including slow",
     )
+    config.addinivalue_line(
+        "markers",
+        "kernels: Pallas kernel parity matrix (interpret mode on CPU); "
+        "the fused tower/Miller kernels compile slowly in interpret "
+        "mode, so these also carry `slow` and run in the dedicated "
+        "kernels CI job, keeping tier-1 fast",
+    )
 
 
 def pytest_collection_modifyitems(session, config, items):

@@ -3,7 +3,8 @@
 PY ?= python
 
 .PHONY: lint lint-baseline test test-lint test-chaos test-crash \
-	test-scenario test-serving test-kernels bench-serving warm-compile
+	test-scenario test-serving test-speculate test-kernels \
+	bench-serving bench-speculate warm-compile
 
 ## lint: AST consensus-safety & TPU-hazard pass (tools/lint, stdlib-only)
 lint:
@@ -45,6 +46,15 @@ test-serving:
 	JAX_PLATFORMS=cpu $(PY) -m pytest tests/test_serving.py -q \
 		-p no:cacheprovider
 
+## test-speculate: duty-driven precompute & speculative verification —
+## the forgery/property suite plus the storm scenario with speculation
+## attached (the CI speculate job)
+test-speculate:
+	JAX_PLATFORMS=cpu $(PY) -m pytest tests/test_speculation.py -q \
+		-p no:cacheprovider
+	JAX_PLATFORMS=cpu $(PY) -m pytest tests/test_scenarios.py -q \
+		-m speculate -p no:cacheprovider
+
 ## test-kernels: full Pallas kernel parity matrix incl. the slow fused
 ## tower/Miller kernels in interpret mode (the CI kernels job)
 test-kernels:
@@ -54,6 +64,13 @@ test-kernels:
 ## bench-serving: cached-vs-uncached requests/s (the CI serving job)
 bench-serving:
 	JAX_PLATFORMS=cpu $(PY) bench.py --serving --out bench-serving.json
+
+## bench-speculate: critical-path aggregate sets/s with the precompute
+## off / on / on+speculation, plus hit/correction/miss ratios (one JSON
+## line on stdout — the artifact)
+bench-speculate:
+	BENCH_PLATFORM=cpu JAX_PLATFORMS=cpu $(PY) bench.py --speculate \
+		| tee bench-speculate.json
 
 ## warm-compile: AOT-compile every verifier shape bucket into ./datadir's
 ## persistent compile cache (deploy-time warm pass; `cli warm`)

@@ -563,6 +563,162 @@ def profile_child() -> None:
     )
 
 
+def speculate_bench() -> None:
+    """`bench.py --speculate`: seeded slots of committee-shaped aggregate
+    traffic through the REAL verification entrypoint
+    (batch_verify_aggregates) with the duty-driven precompute ON vs OFF,
+    reporting critical-path sets/s for both plus the
+    hit/correction/miss/confirm ratios. Same artifact contract as the
+    main bench: exactly ONE JSON line, exit 0 even on failure."""
+    try:
+        _speculate_bench_inner()
+    except BaseException as exc:  # never lose the artifact
+        _emit(
+            {
+                "metric": "speculate_aggregate_sets_per_s",
+                "value": 0.0,
+                "unit": "sets/s",
+                "error": f"speculate bench: {type(exc).__name__}: {exc}",
+            }
+        )
+
+
+def _speculate_bench_inner() -> None:
+    sys.path.insert(0, HERE)
+    _force_platform()
+    from lighthouse_tpu.crypto.bls import set_backend
+
+    # default: the pure-Python oracle backend -- every pairing is real,
+    # sized small; the interesting delta (zero per-set pubkey aggregation
+    # + confirm-by-lookup dropping the indexed set) is backend-agnostic
+    set_backend(os.environ.get("BENCH_SPECULATE_BACKEND", "cpu"))
+    from lighthouse_tpu.chain.attestation_verification import (
+        batch_verify_aggregates,
+    )
+    from lighthouse_tpu.harness import BeaconChainHarness
+    from lighthouse_tpu.pool import ObservedAggregates, ObservedAggregators
+    from lighthouse_tpu.speculate import attach_speculation
+    from lighthouse_tpu.state_transition import clone_state, process_slots
+    from lighthouse_tpu.types import ChainSpec, MINIMAL
+
+    validators = int(os.environ.get("BENCH_SPECULATE_VALIDATORS", "16"))
+    slots = int(os.environ.get("BENCH_SPECULATE_SLOTS", "4"))
+    reps = int(os.environ.get("BENCH_SPECULATE_REPS", "2"))
+    seed = int(os.environ.get("BENCH_SPECULATE_SEED", "7"))
+
+    h = BeaconChainHarness(
+        validators, MINIMAL, ChainSpec.interop(), sign=True
+    )
+    h.extend_chain(slots + 1)
+    chain = h.chain
+    sub = attach_speculation(
+        chain, signature_source=h.producer.aggregate_signature_source()
+    )
+
+    # seeded committee-shaped traffic: one signed aggregate per
+    # (slot, committee) over the last `slots` slots, all inside the
+    # gossip propagation window of the head
+    state = process_slots(
+        clone_state(chain.head_state),
+        int(chain.head_state.slot) + 1,
+        MINIMAL,
+        h.spec,
+    )
+    from lighthouse_tpu.state_transition import ConsensusContext
+    from lighthouse_tpu.types import compute_epoch_at_slot
+
+    ctxt = ConsensusContext(MINIMAL, h.spec)
+    traffic = []
+    head_slot = int(chain.head_state.slot)
+    for slot in range(head_slot - slots + 1, head_slot + 1):
+        epoch = compute_epoch_at_slot(slot, MINIMAL)
+        cache = ctxt.committee_cache(state, epoch)
+        for index in range(cache.committees_per_slot):
+            traffic.append(
+                h.producer.make_signed_aggregate(state, slot, index)
+            )
+    sets_per_agg = 3  # selection proof + aggregate-and-proof + indexed
+
+    def run_pass():
+        t0 = time.perf_counter()
+        verified, rejected = batch_verify_aggregates(
+            chain, traffic, ObservedAggregates(), ObservedAggregators()
+        )
+        return time.perf_counter() - t0, len(verified), len(rejected)
+
+    # OFF: the flag-off baseline (per-set host pubkey aggregation)
+    sub.enabled = False
+    off_times, off_ok = [], None
+    for _ in range(reps):
+        dt, nv, nr = run_pass()
+        off_times.append(dt)
+        off_ok = (nv, nr)
+
+    # ON (precompute only): the memo is empty, so every aggregate rides
+    # the committee-aggregate cache -- this pass yields the hit ratios
+    sub.enabled = True
+    pre_stats = dict(sub.precompute.stats)
+    on_times, on_ok = [], None
+    for _ in range(reps):
+        dt, nv, nr = run_pass()
+        on_times.append(dt)
+        on_ok = (nv, nr)
+    d_pre = {
+        k: sub.precompute.stats[k] - pre_stats[k] for k in pre_stats
+    }
+
+    # ON (+speculation): pre-verify the traffic slots during "idle time",
+    # then the same aggregates are confirmed by memo lookup on arrival
+    ver_stats = dict(sub.verifier.stats)
+    for slot in range(head_slot - slots + 1, head_slot + 1):
+        sub.verifier.speculate_slot(slot)
+    spec_times, spec_ok = [], None
+    for _ in range(reps):
+        dt, nv, nr = run_pass()
+        spec_times.append(dt)
+        spec_ok = (nv, nr)
+    d_ver = {k: sub.verifier.stats[k] - ver_stats[k] for k in ver_stats}
+
+    n = len(traffic)
+    looked_up = max(
+        1, d_pre["full_hits"] + d_pre["corrections"] + d_pre["misses"]
+    )
+    off_best = min(off_times)
+    on_best = min(on_times)
+    spec_best = min(spec_times)
+    _emit(
+        {
+            "metric": "speculate_aggregate_sets_per_s",
+            "value": round(n * sets_per_agg / spec_best, 2),
+            "unit": "sets/s",
+            "seed": seed,
+            "validators": validators,
+            "slots": slots,
+            "aggregates": n,
+            "verified": spec_ok,
+            "verdicts_match_off_path": on_ok == off_ok == spec_ok,
+            "off_sets_per_s": round(n * sets_per_agg / off_best, 2),
+            "precompute_sets_per_s": round(n * sets_per_agg / on_best, 2),
+            "speculate_sets_per_s": round(n * sets_per_agg / spec_best, 2),
+            "precompute_speedup": round(off_best / on_best, 3),
+            "speculate_speedup": round(off_best / spec_best, 3),
+            "precompute": {
+                "full_hit_ratio": round(d_pre["full_hits"] / looked_up, 3),
+                "correction_ratio": round(
+                    d_pre["corrections"] / looked_up, 3
+                ),
+                "miss_ratio": round(d_pre["misses"] / looked_up, 3),
+            },
+            "speculation": {
+                "preverified": d_ver["preverified"],
+                "confirms": d_ver["confirms"],
+                "confirm_misses": d_ver["confirm_misses"],
+                "mismatches": d_ver["mismatches"],
+            },
+        }
+    )
+
+
 def serving_bench() -> None:
     """`bench.py --serving`: the serving-tier load generator (cached vs
     uncached requests/s over a real server). Same artifact contract as
@@ -588,6 +744,8 @@ def main() -> None:
         probe()
     elif "--serving" in sys.argv:
         serving_bench()
+    elif "--speculate" in sys.argv:
+        speculate_bench()
     elif "--profile" in sys.argv:
         profile_child()
     elif "--child" in sys.argv:

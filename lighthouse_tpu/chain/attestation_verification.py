@@ -350,6 +350,19 @@ def _setup_aggregate_batch(
             indexed = ctxt.get_indexed_attestation(
                 state, agg.message.aggregate
             )
+            ind = indexed_attestation_signature_set(
+                state, get_pubkey, indexed, chain.preset, chain.spec
+            )
+            # speculation hook (speculate/): may drop the indexed set
+            # (pre-verified, confirmed by lookup) or swap in a set whose
+            # single pubkey is the precomputed committee aggregate
+            # (identical point => identical verdict). Miss/mismatch keeps
+            # the original set — never trust-on-predict.
+            speculation = getattr(chain, "speculation", None)
+            if speculation is not None:
+                ind = speculation.process_indexed_set(
+                    state, agg.message.aggregate, indexed, ind
+                )
             sets = [
                 selection_proof_signature_set(
                     state, get_pubkey, agg, chain.preset, chain.spec
@@ -357,10 +370,9 @@ def _setup_aggregate_batch(
                 aggregate_and_proof_signature_set(
                     state, get_pubkey, agg, chain.preset, chain.spec
                 ),
-                indexed_attestation_signature_set(
-                    state, get_pubkey, indexed, chain.preset, chain.spec
-                ),
             ]
+            if ind is not None:
+                sets.append(ind)
             survivors.append((agg, sets, indexed))
         except (AttestationError, ValueError) as e:
             rejected.append((agg, str(e)))

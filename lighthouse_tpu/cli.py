@@ -286,6 +286,21 @@ def build_beacon_node(args):
         retry_after_s=getattr(args, "serving_retry_after", 1),
     )
     network = getattr(node, "network", None)
+    if getattr(args, "speculate", False):
+        # duty-driven precompute + idle-time speculation (speculate/):
+        # committee aggregate pubkeys are built at every epoch boundary
+        # and the processor's idle seam pre-verifies expected next-slot
+        # aggregates (when a signature source is wired; precompute alone
+        # already removes per-set pubkey aggregation from the hot path)
+        from .speculate import attach_speculation
+
+        attach_speculation(
+            chain,
+            processor=getattr(network, "processor", None),
+            queue_wait_p95_max=getattr(
+                args, "speculate_queue_wait_p95", 0.05
+            ),
+        )
     server = BeaconApiServer(
         api,
         port=args.http_port,
@@ -349,6 +364,13 @@ def cmd_bn(args):
             # no worker pool running (dry-run / embedded use): drain gossip
             # work inline (the BeaconProcessor worker seat)
             node.network.processor.run_until_idle()
+        elif getattr(node.chain, "speculation", None) is not None and hasattr(
+            node, "network"
+        ):
+            # worker pool mode: run_until_idle never fires here, so the
+            # tick loop offers the speculation idle slot itself (the
+            # processor still refuses unless genuinely idle)
+            node.network.processor.run_idle_task()
 
     executor.spawn_loop(tick, "per-slot", node.spec.seconds_per_slot)
     executor.spawn_loop(notifier, "notifier", node.spec.seconds_per_slot)
@@ -896,6 +918,16 @@ def main(argv=None) -> int:
                          "seconds")
     bn.add_argument("--serving-retry-after", type=int, default=1,
                     help="Retry-After seconds on shed (503) responses")
+    bn.add_argument("--speculate", action="store_true",
+                    help="duty-driven precompute: committee aggregate "
+                         "pubkeys built at each epoch boundary so "
+                         "aggregate verification skips per-set pubkey "
+                         "aggregation, plus idle-time next-slot "
+                         "pre-verification (speculate/)")
+    bn.add_argument("--speculate-queue-wait-p95", type=float, default=0.05,
+                    help="idle gate: speculation only runs while the "
+                         "processor queue-wait p95 stays under this "
+                         "many seconds")
     bn.set_defaults(fn=cmd_bn)
 
     boot = sub.add_parser("boot-node", help="run a discovery bootnode")

@@ -899,3 +899,81 @@ class PubkeyTable:
     def gather(self, indices):
         """(m,) validator indices -> (m, 3, W) device points."""
         return jnp.take(self.device_table(), jnp.asarray(indices), axis=0)
+
+
+# --- speculative verification: committee aggregate residency ----------------
+#
+# The speculate/ subsystem precomputes one aggregate pubkey per
+# (slot, committee) at the epoch boundary. Those synthetic keys live here,
+# device-resident NEXT TO the validator PubkeyTable: registration packs
+# each aggregate's limb tensor once (cached on the key object, so the
+# host-pack marshal path ships a precomputed array instead of converting
+# coordinates on the critical path) and parks the whole family on device
+# for the staged subtract/correct program below.
+
+_committee_table: PubkeyTable | None = None
+
+
+def committee_table() -> PubkeyTable:
+    global _committee_table
+    if _committee_table is None:
+        _committee_table = PubkeyTable()
+    return _committee_table
+
+
+def set_committee_aggregates(pubkeys) -> None:
+    """Replace the device-resident committee-aggregate family (called per
+    precompute refresh; entries are epoch-scoped so the table is rebuilt,
+    not grown). Also warms each key's cached `_tpu_limbs`."""
+    global _committee_table
+    table = PubkeyTable()
+    table.import_new_pubkeys(list(pubkeys))
+    _committee_table = table
+    if len(table):
+        n = len(table)
+        b = _bucket(max(n, 1), floor=8)
+        metrics.SPECULATE_TABLE_BYTES.set(b * 3 * W * 4)
+
+
+def _speculate_device_enabled() -> bool:
+    return os.environ.get("LIGHTHOUSE_TPU_SPECULATE_DEVICE", "0") != "0"
+
+
+@jax.jit
+def _stage_correct(full, absent, absent_real):
+    """full (3, W) projective aggregate; absent (k_b, 3, W) padded member
+    points with a (k_b,) real mask -> affine corrected point
+    (full - sum(absent)) + infinity flag."""
+    F = TC.FP
+    masked = TC.point_select(
+        absent_real, absent, TC.infinity(F, absent.shape[:1]), F
+    )
+    s = _sum_points(masked, F)
+    corrected = TC.add(full, TC.neg(s, F), F)
+    aff, inf = TC.to_affine_g1(corrected[None])
+    return aff[0], inf[0]
+
+
+def correct_aggregate_device(full_pk, absent_pks):
+    """Incremental correction on device: cached full-committee aggregate
+    minus the absent members' points, as one staged program bucketed on
+    the absent count (warm-executable reuse per the verifier's _bucket
+    contract). Returns an oracle affine Point, or None on the degenerate
+    all-absent result (caller falls back to host aggregation)."""
+    from ..curve_ref import Point
+    from ..fields_ref import Fp
+
+    k = len(absent_pks)
+    k_b = _bucket(max(k, 1))
+    absent = np.broadcast_to(_INF_G1, (k_b, 3, W)).copy()
+    for i, pk in enumerate(absent_pks):
+        absent[i] = _pk_limbs(pk)
+    real = np.zeros(k_b, bool)
+    real[:k] = True
+    aff, inf = _stage_correct(
+        jnp.asarray(_pk_limbs(full_pk)), jnp.asarray(absent), jnp.asarray(real)
+    )
+    if bool(inf):
+        return None
+    aff = np.asarray(aff)
+    return Point(Fp(L.to_int(aff[0])), Fp(L.to_int(aff[1])), False)

@@ -75,6 +75,28 @@ class StateHarness:
         sk = interop_secret_key(validator_index)
         return sk.sign(root).to_bytes()
 
+    def aggregate_signature_source(self):
+        """`signature_source(data, members, signing_root) -> bytes` for
+        the speculation scheduler (speculate/): aggregates the members'
+        interop-key signatures over the signing root — the harness/bench
+        stand-in for a deployment that can see its own signers' output
+        ahead of gossip. Returns None when the harness doesn't sign."""
+        if not self.sign:
+            return None
+
+        def source(data, members, signing_root):
+            agg = AggregateSignature.aggregate(
+                [
+                    Signature.from_bytes(
+                        self._sign_root(signing_root, v)
+                    )
+                    for v in members
+                ]
+            )
+            return agg.to_bytes()
+
+        return source
+
     def _randao_reveal(self, state, proposer: int) -> bytes:
         epoch = compute_epoch_at_slot(state.slot, self.preset)
         domain = get_domain(state, DOMAIN_RANDAO, epoch, self.preset)

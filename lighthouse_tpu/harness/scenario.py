@@ -110,6 +110,10 @@ class ScenarioPlan:
     # small values force multi-window hot->cold migrations (the
     # long-non-finality plan exercises the sub-batched path on purpose)
     migration_chunk_slots: int | None = None
+    # attach the duty-driven precompute subsystem (speculate/) to every
+    # node: aggregate verification rides the committee-aggregate cache
+    # and the run asserts the reorg-invalidation + metric-sanity story
+    speculate: bool = False
 
 
 @dataclass
@@ -217,6 +221,18 @@ def _counter_snapshot() -> dict:
     }
 
 
+def _speculate_snapshot() -> dict:
+    return {
+        "precompute_full_hits": M.SPECULATE_PRECOMPUTE_HITS.value,
+        "precompute_corrections": M.SPECULATE_PRECOMPUTE_CORRECTIONS.value,
+        "precompute_misses": M.SPECULATE_PRECOMPUTE_MISSES.value,
+        "precompute_invalidations": M.SPECULATE_PRECOMPUTE_INVALIDATIONS.value,
+        "confirm_hits": M.SPECULATE_CONFIRMS.value,
+        "confirm_misses": M.SPECULATE_CONFIRM_MISSES.value,
+        "mismatches": M.SPECULATE_MISMATCHES.value,
+    }
+
+
 def run_scenario(plan: ScenarioPlan) -> ScenarioResult:
     """Execute a plan start to finish; raises InvariantViolation on any
     safety failure, returns the report + trace (SLO failures are listed
@@ -272,9 +288,11 @@ def _run_scenario(plan: ScenarioPlan) -> ScenarioResult:
         crash_plans=crash_plans,
         attach_slashers=plan.attach_slashers,
         migration_chunk_slots=plan.migration_chunk_slots,
+        speculate=plan.speculate,
     )
     checker = InvariantChecker(sim)
     base_counts = _counter_snapshot()
+    speculate_base = _speculate_snapshot() if plan.speculate else None
     observed_base = M.BLOCK_OBSERVED_DELAY.snapshot()
     imported_base = M.BLOCK_IMPORTED_DELAY.snapshot()
 
@@ -436,6 +454,18 @@ def _run_scenario(plan: ScenarioPlan) -> ScenarioResult:
     if fsck_issues:
         failures.append(f"fsck issues: {fsck_issues}")
 
+    speculation = None
+    if speculate_base is not None:
+        speculation = {
+            k: v - speculate_base[k]
+            for k, v in _speculate_snapshot().items()
+        }
+        speculation["precompute_entries"] = sum(
+            len(n.chain.speculation.precompute)
+            for n in sim.nodes
+            if getattr(n.chain, "speculation", None) is not None
+        )
+
     trace = tracer.dump_json()
     report = {
         "name": plan.name,
@@ -450,6 +480,7 @@ def _run_scenario(plan: ScenarioPlan) -> ScenarioResult:
         "proposer_slashings_found": slashings,
         "byzantine_blocks_gossiped": len(sim.forged_roots)
         + len(sim.equivocation_roots),
+        "speculation": speculation,
         "slo": {
             "observed_delay_p95_s": observed_p95,
             "imported_delay_p95_s": imported_p95,
@@ -645,10 +676,28 @@ def crash_recovery_plan(seed=0, nodes=4, validators=64) -> ScenarioPlan:
     )
 
 
+def equivocation_storm_speculate_plan(
+    seed=0, nodes=4, validators=64
+) -> ScenarioPlan:
+    """The equivocation storm with the duty-driven precompute subsystem
+    attached to every node: the storm's reorgs must drive clean
+    shuffling-key invalidation (never a stale-entry acceptance), the
+    no-Byzantine-import invariant must hold exactly as without
+    speculation, and the speculation counters must stay consistent."""
+    import dataclasses
+
+    return dataclasses.replace(
+        equivocation_storm_plan(seed, nodes, validators),
+        name="equivocation-storm-speculate",
+        speculate=True,
+    )
+
+
 PLANS = {
     "partition": partition_plan,
     "churn": churn_plan,
     "equivocation-storm": equivocation_storm_plan,
+    "equivocation-storm-speculate": equivocation_storm_speculate_plan,
     "long-nonfinality": long_nonfinality_plan,
     "crash-recovery": crash_recovery_plan,
 }

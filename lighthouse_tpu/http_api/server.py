@@ -510,10 +510,11 @@ class BeaconApiServer:
                 self._send(404, {"message": f"no route {method} {path}"})
 
             def _respond_get(self, path, params, handler, m):
-                """GET responses route through the anchored cache: a hit
-                skips the BeaconApi handler entirely; a miss serializes
-                once, stores body+ETag, and either path honours
-                If-None-Match with a bodyless 304."""
+                """GET responses route through the anchored cache via
+                singleflight: a hit skips the BeaconApi handler entirely;
+                concurrent misses on one key run the handler ONCE (the
+                followers are coalesced onto the leader's result); every
+                path honours If-None-Match with a bodyless 304."""
                 tier = outer.serving
                 key = None
                 if tier.config.cache_enabled:
@@ -527,30 +528,21 @@ class BeaconApiServer:
                 if key is None:
                     self._send(200, handler(m))
                     return
+
+                def build():
+                    body = json.dumps(handler(m)).encode()
+                    return body, "application/json", make_etag(body)
+
+                entry, outcome = tier.cache.get_or_compute(key, build)
                 inm = self.headers.get("If-None-Match")
-                entry = tier.cache.lookup(key)
-                if entry is not None:
-                    if inm is not None and inm == entry.etag:
-                        self._send_not_modified(entry.etag)
-                        return
-                    self._send(
-                        200,
-                        entry.body,
-                        entry.content_type,
-                        headers={"ETag": entry.etag, "X-Cache": "hit"},
-                    )
-                    return
-                body = json.dumps(handler(m)).encode()
-                etag = make_etag(body)
-                tier.cache.store(key, body, "application/json", etag)
-                if inm is not None and inm == etag:
-                    self._send_not_modified(etag)
+                if inm is not None and inm == entry.etag:
+                    self._send_not_modified(entry.etag)
                     return
                 self._send(
                     200,
-                    body,
-                    "application/json",
-                    headers={"ETag": etag, "X-Cache": "miss"},
+                    entry.body,
+                    entry.content_type,
+                    headers={"ETag": entry.etag, "X-Cache": outcome},
                 )
 
             def _send_not_modified(self, etag: str):

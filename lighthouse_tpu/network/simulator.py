@@ -34,6 +34,7 @@ class Simulator:
         crash_plans: dict | None = None,
         attach_slashers: bool = False,
         migration_chunk_slots: int | None = None,
+        speculate: bool = False,
     ):
         self.preset = preset
         self.spec = spec or ChainSpec.interop()
@@ -55,6 +56,9 @@ class Simulator:
         self.validator_count = validator_count
         self.attach_slashers = attach_slashers
         self.migration_chunk_slots = migration_chunk_slots
+        # duty-driven precompute on every node: the scenario-level knob
+        # that proves reorg invalidation + metric sanity under storms
+        self.speculate = speculate
         # seeded per-node crash schedules: node index -> CrashPlan; the
         # node's kv routes every mutation through CrashingStore so an
         # armed plan kills "the process" at exactly the Nth store op
@@ -100,6 +104,10 @@ class Simulator:
         chain = BeaconChain(
             store, clone_state(self.genesis), self.preset, self.spec
         )
+        if self.speculate:
+            from ..speculate import attach_speculation
+
+            attach_speculation(chain)
         node = NetworkNode(peer_id or f"node{index}", chain, self.bus)
         node.sim_index = index
         if self.attach_slashers:
@@ -179,6 +187,10 @@ class Simulator:
             migration_chunk_slots=self.migration_chunk_slots,
         )
         chain = BeaconChain.from_store(store, self.preset, self.spec)
+        if self.speculate:
+            from ..speculate import attach_speculation
+
+            attach_speculation(chain)
         fresh = NetworkNode(node.peer_id, chain, self.bus)
         fresh.sim_index = getattr(node, "sim_index", -1)
         if self.attach_slashers:
@@ -304,6 +316,13 @@ class Simulator:
         except InjectedCrash:
             self.mark_dead(home)
             return
+        if self.speculate and atts:
+            # gossip a real SignedAggregateAndProof so the aggregate
+            # verification path (and with it the precompute hook) runs
+            # on every receiving node, not just block-carried votes
+            home.publish_aggregate(
+                self.producer.make_signed_aggregate(adv, slot - 1, 0)
+            )
         if equivocate or forge:
             # the Byzantine injector must sit on THIS group's side of any
             # installed split, or its gossip would reach nobody and the

@@ -162,6 +162,9 @@ class BeaconProcessor:
             "gossip_sync_contribution",
         }
         self.handlers = handlers
+        # optional idle-time callback (speculate/): invoked when queues
+        # are drained and nothing is deferred — see set_idle_task
+        self.idle_task = None
         self._lock = threading.Lock()
         self._work_available = threading.Condition(self._lock)
         self._stop = threading.Event()
@@ -288,6 +291,38 @@ class BeaconProcessor:
                 "busy_workers": self._busy_workers,
             }
 
+    def set_idle_task(self, fn) -> None:
+        """Register (or clear with None) a callback for idle device time.
+        `run_until_idle` fires it once after draining; worker-pool
+        deployments call `run_idle_task()` from their tick loop. The task
+        runs OUTSIDE the lock and must itself be cheap/abortable — it is
+        a scavenger of idle cycles, never a priority class."""
+        self.idle_task = fn
+
+    def run_idle_task(self) -> bool:
+        """Invoke the idle task iff the processor is genuinely idle
+        (empty queues, no deferred verdicts, no busy workers). Returns
+        True when the task ran. Exceptions are counted like handler
+        failures — idle work must never kill its caller."""
+        fn = self.idle_task
+        if fn is None:
+            return False
+        with self._lock:
+            idle = (
+                self._busy_workers == 0
+                and not self._deferred
+                and not any(len(q) for q in self.queues.values())
+            )
+        if not idle:
+            return False
+        try:
+            fn()
+        # lint: allow[broad-except] -- same survival boundary as handlers
+        except Exception as exc:  # noqa: BLE001 -- idle work is
+            # best-effort by contract; count and move on
+            self._count_error("idle_task", exc)
+        return True
+
     def _complete_deferred(self, block: bool) -> bool:
         """Resolve the OLDEST deferred batch (submit order). With
         block=False only if its device work already finished. Returns
@@ -317,6 +352,7 @@ class BeaconProcessor:
         (resolving deferred batch verdicts as they land); returns
         work-item count (synchronous mode: tests, simulator)."""
         done = 0
+        idle_ran = False
         while True:
             while self._complete_deferred(block=False):
                 pass
@@ -325,6 +361,12 @@ class BeaconProcessor:
             if name is None:
                 if self._complete_deferred(block=True):
                     continue
+                # drained: give the idle task its one shot (speculation
+                # etc.), then re-check — it may have submitted work
+                if not idle_ran and self.idle_task is not None:
+                    idle_ran = True
+                    if self.run_idle_task():
+                        continue
                 return done
             self._execute(name, items)
             done += len(items)

@@ -86,6 +86,21 @@ class CacheEntry:
     anchor: object
 
 
+class _Flight:
+    """One in-flight computation of a cache key (singleflight): the
+    leader computes, followers wait on the event and read the entry (or
+    the error) off the flight object — the flight may outlive its slot
+    in the flights dict and the entry may already be LRU-evicted from
+    the cache by the time a follower wakes, so the result rides HERE."""
+
+    __slots__ = ("event", "entry", "error")
+
+    def __init__(self):
+        self.event = threading.Event()
+        self.entry: CacheEntry | None = None
+        self.error: BaseException | None = None
+
+
 class ResponseCache:
     """LRU-bounded map of response bytes, invalidated by anchor moves."""
 
@@ -96,6 +111,9 @@ class ResponseCache:
         self.hits = 0
         self.misses = 0
         self.invalidations = 0
+        self.coalesced = 0
+        # singleflight: key -> in-flight leader computation
+        self._flights: dict[tuple, _Flight] = {}
 
     @staticmethod
     def key(path: str, params: dict, kind: str, anchor) -> tuple:
@@ -125,6 +143,59 @@ class ResponseCache:
             while len(self._entries) > self.max_entries:
                 self._entries.popitem(last=False)
             M.SERVING_CACHE_ENTRIES.set(len(self._entries))
+
+    def get_or_compute(
+        self, key: tuple, compute, timeout: float = 10.0
+    ) -> tuple[CacheEntry, str]:
+        """Singleflight read-through: a hit returns immediately; on a
+        miss, N concurrent callers of the same key run ONE `compute()`
+        (`() -> (body, content_type, etag)`) — the first caller leads,
+        the rest block on its result and are counted as coalesced.
+        Returns (entry, outcome) with outcome in {"hit", "miss",
+        "coalesced"}. A leader failure (or follower timeout) degrades
+        each follower to computing for itself — coalescing is an
+        optimization, never a correctness dependency."""
+        entry = self.lookup(key)
+        if entry is not None:
+            return entry, "hit"
+        with self._lock:
+            flight = self._flights.get(key)
+            if flight is None:
+                flight = self._flights[key] = _Flight()
+                leader = True
+            else:
+                leader = False
+        if not leader:
+            self.coalesced += 1
+            M.SERVING_COALESCED.inc()
+            flight.event.wait(timeout)
+            if flight.entry is not None:
+                return flight.entry, "coalesced"
+            # leader failed or timed out: compute for ourselves (no
+            # flight registration — correctness over dedup here)
+            body, content_type, etag = compute()
+            self.store(key, body, content_type, etag)
+            return (
+                CacheEntry(body, content_type, etag, key[2], key[3]),
+                "coalesced",
+            )
+        try:
+            body, content_type, etag = compute()
+            # entry is built directly and set BEFORE the event fires: a
+            # woken follower always sees the result even if the LRU has
+            # already evicted the stored copy under churn
+            flight.entry = CacheEntry(
+                body, content_type, etag, key[2], key[3]
+            )
+        except BaseException as exc:
+            flight.error = exc
+            raise
+        finally:
+            with self._lock:
+                self._flights.pop(key, None)
+            flight.event.set()
+        self.store(key, body, content_type, etag)
+        return flight.entry, "miss"
 
     def invalidate(self, kind: str, anchor) -> int:
         """Drop every entry of `kind` whose anchor differs from the new
@@ -161,4 +232,5 @@ class ResponseCache:
                 "hits": self.hits,
                 "misses": self.misses,
                 "invalidations": self.invalidations,
+                "coalesced": self.coalesced,
             }

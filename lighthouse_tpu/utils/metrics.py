@@ -572,3 +572,68 @@ SERVING_EVENT_RING_DROPPED = REGISTRY.counter(
     "http_serving_event_ring_dropped_total",
     "Oldest events evicted from the bounded replay ring (api.events)",
 )
+SERVING_COALESCED = REGISTRY.counter(
+    "http_serving_coalesced_requests_total",
+    "Cache-miss GETs coalesced onto another in-flight computation of the "
+    "same (route, params, anchor) key (singleflight followers)",
+)
+
+# -- speculative verification (speculate/: committee precompute + idle-time
+#    next-slot pre-verification) ---------------------------------------------
+
+SPECULATE_PRECOMPUTE_ENTRIES = REGISTRY.gauge(
+    "speculate_precompute_entries",
+    "Per-(slot, committee) aggregate-pubkey precompute entries currently "
+    "cached (keyed on the epoch's shuffling seed)",
+)
+SPECULATE_PRECOMPUTE_HITS = REGISTRY.counter(
+    "speculate_precompute_full_hits_total",
+    "Indexed-attestation sets whose aggregation bits matched a cached "
+    "full-committee aggregate exactly (zero pubkey aggregation on the "
+    "critical path)",
+)
+SPECULATE_PRECOMPUTE_CORRECTIONS = REGISTRY.counter(
+    "speculate_precompute_corrections_total",
+    "Partial-participation sets served by incremental correction "
+    "(cached full aggregate minus absent members)",
+)
+SPECULATE_PRECOMPUTE_MISSES = REGISTRY.counter(
+    "speculate_precompute_misses_total",
+    "Indexed-attestation sets that fell through to normal per-set pubkey "
+    "aggregation (no entry, stale shuffling key, or member mismatch)",
+)
+SPECULATE_PRECOMPUTE_INVALIDATIONS = REGISTRY.counter(
+    "speculate_precompute_invalidations_total",
+    "Precompute entries dropped because a reorg changed the epoch's "
+    "shuffling seed (same-shuffling reorgs keep entries)",
+)
+SPECULATE_PREVERIFIED = REGISTRY.counter(
+    "speculate_preverified_total",
+    "Expected next-slot aggregates pre-verified during idle device time "
+    "and memoized for confirm-on-arrival",
+)
+SPECULATE_CONFIRMS = REGISTRY.counter(
+    "speculate_confirm_hits_total",
+    "Arriving aggregates confirmed by speculation-memo lookup instead of "
+    "pairing on the critical path",
+)
+SPECULATE_CONFIRM_MISSES = REGISTRY.counter(
+    "speculate_confirm_misses_total",
+    "Arriving aggregates with no matching speculation memo (fell through "
+    "to the normal verified path)",
+)
+SPECULATE_MISMATCHES = REGISTRY.counter(
+    "speculate_mismatches_total",
+    "Arriving aggregates whose memo key matched but whose signature bytes "
+    "differed from the pre-verified one (never trusted; full verify)",
+)
+SPECULATE_IDLE_RUNS = REGISTRY.counter(
+    "speculate_idle_runs_total",
+    "Idle-time speculation passes actually run by the processor (gated "
+    "on queue-wait p95 and in-flight depth)",
+)
+SPECULATE_TABLE_BYTES = REGISTRY.gauge(
+    "speculate_committee_table_bytes",
+    "Device-resident per-committee aggregate-pubkey table size in bytes "
+    "(lives next to the validator pubkey table in the jax_tpu backend)",
+)

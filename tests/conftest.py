@@ -66,6 +66,12 @@ def pytest_configure(config):
     )
     config.addinivalue_line(
         "markers",
+        "speculate: duty-driven precompute & speculative verification "
+        "(speculate/): forgery/property suite plus the storm scenario "
+        "with speculation attached; CI runs these as a dedicated step",
+    )
+    config.addinivalue_line(
+        "markers",
         "kernels: Pallas kernel parity matrix (interpret mode on CPU); "
         "the fused tower/Miller kernels compile slowly in interpret "
         "mode, so these also carry `slow` and run in the dedicated "

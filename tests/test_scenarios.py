@@ -153,7 +153,7 @@ class TestScenarioMatrix:
         assert report["slo"]["failures"] == [], report["slo"]
         assert len(report["final_heads"]) == 1
         assert report["fsck_issues"] == {}
-        if name == "equivocation-storm":
+        if name.startswith("equivocation-storm"):
             assert report["byzantine_blocks_gossiped"] > 0
             assert report["proposer_slashings_found"] > 0
         if name == "crash-recovery":
@@ -171,6 +171,39 @@ class TestScenarioMatrix:
             ), report["crash_recoveries"]
         if name == "long-nonfinality":
             assert report["finalized_epoch"] >= 5
+
+    @pytest.mark.speculate
+    def test_equivocation_storm_with_speculation(self):
+        """The storm with duty-driven precompute attached to every node:
+        gossiped aggregates ride the committee-aggregate cache, the
+        no-Byzantine-import invariant (checked per slot inside
+        run_scenario) must hold exactly as without speculation, and the
+        speculation counters must stay consistent across the storm's
+        reorgs — in particular zero mismatches (nothing was memoized
+        without a real verification) and a hot path that actually hit
+        the precompute."""
+        from lighthouse_tpu.harness.scenario import (
+            equivocation_storm_speculate_plan,
+        )
+
+        report = run_scenario(equivocation_storm_speculate_plan()).report
+        assert report["slo"]["failures"] == [], report["slo"]
+        assert report["byzantine_blocks_gossiped"] > 0
+        spec = report["speculation"]
+        assert spec is not None
+        # aggregates were served by the precompute (full hit or
+        # incremental correction), not only missed past it
+        assert spec["precompute_full_hits"] + spec["precompute_corrections"] > 0
+        # never trust-on-predict: no signature source is wired in the
+        # simulator, so nothing is memoized -> confirms stay zero and a
+        # nonzero mismatch would mean a phantom memo entry
+        assert spec["confirm_hits"] == 0
+        assert spec["mismatches"] == 0
+        # counters are deltas over the run: none may go negative
+        assert all(v >= 0 for v in spec.values()), spec
+        # live entries survive at scenario end (current + next epoch on
+        # each node)
+        assert spec["precompute_entries"] > 0
 
     def test_long_nonfinality_migration_is_sub_batched(self, monkeypatch):
         """The multi-epoch finality jump must commit its hot->cold

@@ -241,6 +241,123 @@ class TestResponseCache:
             is not None
         )
 
+    def test_singleflight_coalesces_concurrent_misses(self, rig):
+        """Acceptance: N concurrent GETs on one uncached key run the
+        handler ONCE — the leader computes while the followers park on
+        the flight and are counted as coalesced, and every response is
+        byte-identical."""
+        import time
+
+        from lighthouse_tpu.utils import metrics as M
+
+        h, node, api, server, base = rig
+        h.extend_chain(2)
+        tier = server.serving
+        release = threading.Event()
+        calls = []
+        orig = api.get_finality_checkpoints
+
+        def slow(state_id):
+            calls.append(state_id)
+            assert release.wait(5), "test gate never opened"
+            return orig(state_id)
+
+        api.get_finality_checkpoints = slow
+        url = (
+            base
+            + "/eth/v1/beacon/states/finalized/finality_checkpoints"
+        )
+        n = 4
+        coalesced0 = tier.cache.coalesced
+        metric0 = M.SERVING_COALESCED.value
+        results = []
+        res_lock = threading.Lock()
+
+        def fetch():
+            out = _get(url)
+            with res_lock:
+                results.append(out)
+
+        threads = [threading.Thread(target=fetch) for _ in range(n)]
+        for t in threads:
+            t.start()
+        # deterministic sync: wait until every follower has parked on
+        # the leader's flight, then open the gate
+        deadline = time.monotonic() + 5
+        while (
+            tier.cache.coalesced - coalesced0 < n - 1
+            and time.monotonic() < deadline
+        ):
+            time.sleep(0.002)
+        release.set()
+        for t in threads:
+            t.join(5)
+        assert len(calls) == 1, "followers must never reach the handler"
+        assert len(results) == n
+        bodies = {body for _, _, body in results}
+        assert len(bodies) == 1, "all coalesced responses byte-identical"
+        outcomes = sorted(hdrs.get("X-Cache") for _, hdrs, _ in results)
+        assert outcomes.count("coalesced") == n - 1
+        assert outcomes.count("miss") == 1
+        assert tier.cache.coalesced - coalesced0 == n - 1
+        assert M.SERVING_COALESCED.value - metric0 == n - 1
+        # the flight is gone and a later GET is a plain cache hit
+        assert not tier.cache._flights
+        _, hdrs, _ = _get(url)
+        assert hdrs.get("X-Cache") == "hit"
+
+    def test_singleflight_leader_failure_degrades_followers(self):
+        """A leader exception must not wedge the followers: they wake,
+        compute for themselves, and the flight is cleaned up."""
+        from lighthouse_tpu.serving import ResponseCache
+
+        cache = ResponseCache(max_entries=8)
+        key = ResponseCache.key("/r/x", {}, "head", "0xaa")
+        started = threading.Event()
+        release = threading.Event()
+        errors = []
+
+        def failing():
+            started.set()
+            assert release.wait(5)
+            raise RuntimeError("leader boom")
+
+        def leader():
+            try:
+                cache.get_or_compute(key, failing)
+            except RuntimeError as exc:
+                errors.append(exc)
+
+        t_leader = threading.Thread(target=leader)
+        t_leader.start()
+        assert started.wait(5)
+        follower_result = []
+
+        def follower():
+            follower_result.append(
+                cache.get_or_compute(
+                    key, lambda: (b"ok", "application/json", 'W/"f"')
+                )
+            )
+
+        t_follower = threading.Thread(target=follower)
+        t_follower.start()
+        # wait for the follower to register as coalesced, then fail the
+        # leader
+        import time
+
+        deadline = time.monotonic() + 5
+        while cache.coalesced < 1 and time.monotonic() < deadline:
+            time.sleep(0.002)
+        release.set()
+        t_leader.join(5)
+        t_follower.join(5)
+        assert errors, "leader exception propagates to the leader"
+        entry, outcome = follower_result[0]
+        assert outcome == "coalesced"
+        assert entry.body == b"ok"
+        assert not cache._flights
+
 
 # -- admission control --------------------------------------------------------
 

@@ -4,7 +4,7 @@ PY ?= python
 
 .PHONY: lint lint-baseline test test-lint test-chaos test-crash \
 	test-scenario test-serving test-speculate test-kernels \
-	bench-serving bench-speculate warm-compile
+	test-fuzz fuzz bench-serving bench-speculate warm-compile
 
 ## lint: AST consensus-safety & TPU-hazard pass (tools/lint, stdlib-only)
 lint:
@@ -35,11 +35,27 @@ test-crash:
 	JAX_PLATFORMS=cpu $(PY) -m pytest tests/test_crash_safety.py -q \
 		-p no:cacheprovider
 
-## test-scenario: full adversarial scenario matrix incl. slow scale runs
-## (the CI scenario job; tier-1 keeps only the small seeded scenario)
+## test-scenario: full adversarial scenario matrix incl. the combined
+## plans, Byzantine validator clients, serving-under-chaos, wire
+## transport, and slow scale runs (the CI scenario job; tier-1 keeps
+## only the small seeded scenarios)
 test-scenario:
+	JAX_PLATFORMS=cpu $(PY) -m pytest tests/test_scenarios.py \
+		tests/test_byzantine_vc.py -q -m scenario -p no:cacheprovider
 	JAX_PLATFORMS=cpu $(PY) -m pytest tests/test_scenarios.py -q \
-		-m scenario -p no:cacheprovider
+		-m wire -p no:cacheprovider
+
+## test-fuzz: fuzzing machinery unit tests + tier-1 replay of the pinned
+## corpus reproducers under their recorded plants
+test-fuzz:
+	JAX_PLATFORMS=cpu $(PY) -m pytest tests/test_fuzz.py -q \
+		-p no:cacheprovider
+
+## fuzz: a budgeted seeded fuzz window (the CI fuzz job); exit code is
+## the number of findings, minimized reproducers land in fuzz-findings/
+fuzz:
+	JAX_PLATFORMS=cpu $(PY) -m tools.fuzz_cli --start-seed 0 \
+		--iterations 12 --budget-s 1200 --corpus-dir fuzz-findings
 
 ## test-serving: serving-tier suite (cache, SSE fan-out, admission)
 test-serving:

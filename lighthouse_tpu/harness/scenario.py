@@ -45,6 +45,7 @@ from ..resilience.primitives import VirtualClock
 from ..types import MINIMAL, ChainSpec
 from ..utils import metrics as M
 from ..utils import tracing
+from ..validator_client.byzantine import ByzPlan
 
 
 class InvariantViolation(AssertionError):
@@ -78,9 +79,20 @@ class Phase:
     crash_node: int | None = None
     crash_after_ops: int = 20
     crash_action: str = "after"
+    # None = arm at phase start; an int re-arms the plan that many slots
+    # INTO the phase (crash DURING non-finality / mid-storm composition)
+    crash_arm_at: int | None = None
     # transport fault rates for the phase (seeded FaultPlan on req/resp)
     error_rate: float = 0.0
     delay_rate: float = 0.0
+    # mid-phase re-rating: ((slot_offset, error_rate, delay_rate), ...)
+    # applied via FaultPlan.set_rates when the phase reaches slot_offset
+    rates_at: tuple = ()
+    # Byzantine validator clients: a ByzPlan turns a sampled fraction of
+    # each node's homed validators Byzantine for this phase — slashable
+    # duties signed through the REAL validator-store path with slashing
+    # protection bypassed-and-audited (validator_client/byzantine.py)
+    byz: ByzPlan | None = None
 
 
 @dataclass(frozen=True)
@@ -95,6 +107,7 @@ class SLO:
     max_breaker_transitions: int | None = None
     max_bisection_calls: int | None = None
     expect_proposer_slashings: bool = False
+    expect_attester_slashings: bool = False
     fsck_clean: bool = True
 
 
@@ -114,6 +127,14 @@ class ScenarioPlan:
     # node: aggregate verification rides the committee-aggregate cache
     # and the run asserts the reorg-invalidation + metric-sanity story
     speculate: bool = False
+    # "memory" (in-process MessageBus) or "wire" (real WireBus TCP
+    # sockets under a deterministic WireFabric — same plans, same
+    # invariants, same bit-identical replay over actual frames)
+    transport: str = "memory"
+    # attach a real BeaconApiServer to node 0 and replay a seeded HTTP
+    # mix mid-scenario; serving SLOs (validator-lane immunity, cache
+    # consistency after reorgs, SSE delivery) become end-of-run checks
+    serving: bool = False
 
 
 @dataclass
@@ -268,7 +289,9 @@ def _run_scenario(plan: ScenarioPlan) -> ScenarioResult:
     bls_pipeline.configure()
     spec = ChainSpec.interop()
     preset = MINIMAL
-    needs_faults = any(p.error_rate or p.delay_rate for p in plan.phases)
+    needs_faults = any(
+        p.error_rate or p.delay_rate or p.rates_at for p in plan.phases
+    )
     fault_plan = (
         FaultPlan(seed=plan.seed, clock=VirtualClock())
         if needs_faults
@@ -279,17 +302,44 @@ def _run_scenario(plan: ScenarioPlan) -> ScenarioResult:
         for p in plan.phases
         if p.crash_node is not None
     }
-    sim = Simulator(
-        plan.node_count,
-        plan.validator_count,
-        preset,
-        spec,
-        fault_plan=fault_plan,
-        crash_plans=crash_plans,
-        attach_slashers=plan.attach_slashers,
-        migration_chunk_slots=plan.migration_chunk_slots,
-        speculate=plan.speculate,
-    )
+    fabric = None
+    if plan.transport == "wire":
+        from ..network.wire_fabric import WireFabric
+
+        fabric = WireFabric(seed=plan.seed)
+    elif plan.transport != "memory":
+        raise ValueError(f"unknown transport {plan.transport!r}")
+    try:
+        sim = Simulator(
+            plan.node_count,
+            plan.validator_count,
+            preset,
+            spec,
+            fault_plan=fault_plan,
+            crash_plans=crash_plans,
+            attach_slashers=plan.attach_slashers,
+            migration_chunk_slots=plan.migration_chunk_slots,
+            speculate=plan.speculate,
+            bus=fabric,
+        )
+        serving = _ServingRig(sim) if plan.serving else None
+        try:
+            return _drive_plan(
+                plan, sim, fault_plan, crash_plans, serving, tracer
+            )
+        finally:
+            if serving is not None:
+                serving.stop()
+    finally:
+        if fabric is not None:
+            fabric.close()
+
+
+def _drive_plan(
+    plan: ScenarioPlan, sim, fault_plan, crash_plans, serving, tracer
+) -> ScenarioResult:
+    from ..store.fsck import run_fsck
+
     checker = InvariantChecker(sim)
     base_counts = _counter_snapshot()
     speculate_base = _speculate_snapshot() if plan.speculate else None
@@ -324,10 +374,14 @@ def _run_scenario(plan: ScenarioPlan) -> ScenarioResult:
             fault_plan.set_rates(
                 error_rate=phase.error_rate, delay_rate=phase.delay_rate
             )
-        if phase.crash_node is not None:
+        if phase.crash_node is not None and phase.crash_arm_at is None:
             crash_plans[phase.crash_node].arm(
                 phase.crash_after_ops, action=phase.crash_action
             )
+        # per-phase Byzantine roster (clears when the phase has none);
+        # its own seeded stream so byz sampling never perturbs the
+        # withholding schedule of pre-existing plans
+        sim.set_byz_plan(phase.byz, random.Random(plan.seed * 7000003 + pi))
         active = None
         if phase.withhold_fraction:
             withheld = set(
@@ -339,6 +393,21 @@ def _run_scenario(plan: ScenarioPlan) -> ScenarioResult:
             active = set(range(plan.validator_count)) - withheld
         for s_i in range(phase.slots):
             storm_ready = slot > 2
+            # mid-phase composition: re-arm the crash plan / re-rate the
+            # fault plan at slot offsets INTO the phase
+            if (
+                phase.crash_node is not None
+                and phase.crash_arm_at == s_i
+            ):
+                crash_plans[phase.crash_node].arm(
+                    phase.crash_after_ops, action=phase.crash_action
+                )
+            if fault_plan is not None:
+                for off, err, delay in phase.rates_at:
+                    if off == s_i:
+                        fault_plan.set_rates(
+                            error_rate=err, delay_rate=delay
+                        )
             sim.run_slot(
                 slot,
                 active_validators=active,
@@ -351,6 +420,11 @@ def _run_scenario(plan: ScenarioPlan) -> ScenarioResult:
                     storm_ready
                     and phase.forge_every
                     and s_i % phase.forge_every == 0
+                ),
+                byzantine=bool(
+                    storm_ready
+                    and phase.byz is not None
+                    and s_i % max(1, phase.byz.every) == 0
                 ),
             )
             if (
@@ -388,6 +462,10 @@ def _run_scenario(plan: ScenarioPlan) -> ScenarioResult:
                 sim.drain()
             checker.check_slot(slot)
             slot += 1
+        if serving is not None:
+            # replay the HTTP mix against node 0 with this phase's chaos
+            # knobs still installed (mid-partition / mid-storm traffic)
+            serving.replay(random.Random(plan.seed * 9000011 + pi))
 
     # final settle: heal anything still split, sync stragglers
     sim.heal()
@@ -411,6 +489,26 @@ def _run_scenario(plan: ScenarioPlan) -> ScenarioResult:
         for n in sim.nodes
         if n.slasher_service is not None
     )
+    att_slashings = sum(
+        n.slasher_service.attester_slashings_found
+        for n in sim.nodes
+        if n.slasher_service is not None
+    )
+    # speculation must NEVER confirm a byz-emitted aggregate by lookup:
+    # a confirm accepts without re-verifying, so a byz aggregate in the
+    # confirmed audit trail is a safety violation, not an SLO miss
+    if sim.byz_aggregate_roots:
+        byz_roots = set(sim.byz_aggregate_roots)
+        for n in sim.nodes:
+            sub = getattr(n.chain, "speculation", None)
+            if sub is None:
+                continue
+            hit = byz_roots & set(sub.confirmed_roots)
+            if hit:
+                raise InvariantViolation(
+                    f"{n.peer_id} speculation confirmed a Byzantine "
+                    f"aggregate by lookup: {sorted(hit)[0].hex()[:12]}"
+                )
     fsck_issues: dict[str, list[str]] = {}
     if plan.slo.fsck_clean:
         for n in sim.nodes:
@@ -451,8 +549,14 @@ def _run_scenario(plan: ScenarioPlan) -> ScenarioResult:
             failures.append(f"{key} {deltas[key]} > budget {bound}")
     if slo.expect_proposer_slashings and slashings == 0:
         failures.append("no proposer slashing detected during the storm")
+    if slo.expect_attester_slashings and att_slashings == 0:
+        failures.append("no attester slashing detected during the storm")
     if fsck_issues:
         failures.append(f"fsck issues: {fsck_issues}")
+    serving_report = None
+    if serving is not None:
+        serving_report = serving.report()
+        failures.extend(serving_report["failures"])
 
     speculation = None
     if speculate_base is not None:
@@ -478,8 +582,16 @@ def _run_scenario(plan: ScenarioPlan) -> ScenarioResult:
         "invariants": {"checked_slots": checker.checked_slots},
         "crash_recoveries": crash_recoveries,
         "proposer_slashings_found": slashings,
+        "attester_slashings_found": att_slashings,
         "byzantine_blocks_gossiped": len(sim.forged_roots)
         + len(sim.equivocation_roots),
+        "byzantine": {
+            "counts": dict(sim.byz_counts),
+            "protection_overrides": sim.total_byz_overrides(),
+            "aggregates_emitted": len(sim.byz_aggregate_roots),
+        },
+        "serving": serving_report,
+        "transport": plan.transport,
         "speculation": speculation,
         "slo": {
             "observed_delay_p95_s": observed_p95,
@@ -493,6 +605,130 @@ def _run_scenario(plan: ScenarioPlan) -> ScenarioResult:
         "trace_sha256": hashlib.sha256(trace.encode()).hexdigest(),
     }
     return ScenarioResult(report=report, trace=trace)
+
+
+class _ServingRig:
+    """Serving-under-chaos composition: a REAL BeaconApiServer over node
+    0's chain, hit with a seeded HTTP mix after every phase — while the
+    phase's partitions/storms/faults are still installed — plus one live
+    SSE subscriber. At scenario end it turns the serving SLOs into
+    checks: the validator lane is never shed or failed, the cached
+    head-root answer agrees with the chain's actual head after every
+    reorg of the run, and head events were actually delivered over SSE.
+
+    Serving plans must not crash or churn node 0: the tier is anchored
+    on its chain object for the whole run (documented contract, same as
+    a real deployment pinning its HTTP front-end to one process)."""
+
+    READ_ROUTES = (
+        "/eth/v1/beacon/states/head/root",
+        "/eth/v1/beacon/headers/head",
+        "/eth/v1/beacon/genesis",
+        "/eth/v1/beacon/states/finalized/finality_checkpoints",
+        "/eth/v1/node/version",
+    )
+    DEBUG_ROUTE = "/lighthouse/health"
+
+    def __init__(self, sim):
+        from ..http_api import BeaconApi, BeaconApiServer
+        from ..validator_client import InProcessBeaconNode
+
+        self.sim = sim
+        self.chain = sim.nodes[0].chain
+        self.server = BeaconApiServer(BeaconApi(InProcessBeaconNode(self.chain)))
+        self.server.start()
+        self.tier = self.server.serving
+        self.base = f"http://127.0.0.1:{self.server.port}"
+        self.sse = self.tier.broadcaster.subscribe(topics=("head",))
+        self.requests = 0
+        self.statuses: dict[int, int] = {}
+        self.validator_failures: list[str] = []
+
+    def _get(self, path: str) -> tuple[int, bytes]:
+        import urllib.error
+        import urllib.request
+
+        try:
+            with urllib.request.urlopen(self.base + path, timeout=30) as r:
+                return int(r.status), r.read()
+        except urllib.error.HTTPError as e:
+            return int(e.code), e.read()
+        except OSError as e:
+            self.statuses[-1] = self.statuses.get(-1, 0) + 1
+            return -1, str(e).encode()
+
+    def replay(self, rng: random.Random, reads: int = 10) -> None:
+        """One seeded traffic burst: a read-only mix, a debug-lane probe
+        (sheddable), and a validator-duties request (NEVER sheddable —
+        admission's structural immunity is asserted end-of-run)."""
+        spe = self.sim.preset.slots_per_epoch
+        epoch = int(self.chain.head_state.slot) // spe
+        paths = [rng.choice(self.READ_ROUTES) for _ in range(reads)]
+        paths.append(self.DEBUG_ROUTE)
+        paths.append(f"/eth/v1/validator/duties/proposer/{epoch}")
+        for path in paths:
+            code, _ = self._get(path)
+            self.requests += 1
+            self.statuses[code] = self.statuses.get(code, 0) + 1
+            if path.startswith("/eth/v1/validator/") and code != 200:
+                self.validator_failures.append(f"{path} -> {code}")
+
+    def report(self) -> dict:
+        """End-of-run serving SLO checks (run while the server is still
+        up, before stop())."""
+        import json as _json
+
+        failures: list[str] = []
+        if self.validator_failures:
+            failures.append(
+                "validator lane degraded under chaos: "
+                f"{self.validator_failures[:3]}"
+            )
+        # cache consistency after reorgs: two reads (second one from the
+        # warm cache) must both name the chain's ACTUAL head. The probe
+        # is the AUDIT, not traffic — admission pressure is windowed over
+        # the whole chaotic run and would shed it, so zero the health
+        # source for the duration (shed responses never consult the
+        # cache, so a shed probe would prove nothing either way).
+        actual = "0x" + bytes(self.chain.head_root).hex()
+        admission = self.tier.admission
+        saved_health = admission.health_source
+        admission.health_source = lambda: {}
+        try:
+            for attempt in ("cold", "warm"):
+                code, body = self._get("/eth/v1/beacon/blocks/head/root")
+                served = None
+                if code == 200:
+                    served = _json.loads(body)["data"]["root"]
+                if served != actual:
+                    failures.append(
+                        f"head-root cache inconsistent after reorg "
+                        f"({attempt}): served {served} != chain {actual}"
+                    )
+        finally:
+            admission.health_source = saved_health
+        # SSE delivery: the run's head events must have reached the
+        # subscriber (drain the buffer; drops still count as delivered
+        # fan-out — the bound is the contract, silence is the failure)
+        events = self.sse.dropped if self.sse is not None else 0
+        while self.sse is not None:
+            item = self.sse.pop(timeout=0)
+            if item is None:
+                break
+            events += 1
+        if events == 0:
+            failures.append("no head events delivered over SSE")
+        return {
+            "requests": self.requests,
+            "statuses": dict(sorted(self.statuses.items())),
+            "sse_head_events": events,
+            "admission": self.tier.admission.stats(),
+            "cache": self.tier.cache.stats(),
+            "failures": failures,
+        }
+
+    def stop(self) -> None:
+        self.server.stop()
 
 
 def _partition_by_sim_index(sim, groups) -> None:
@@ -693,6 +929,221 @@ def equivocation_storm_speculate_plan(
     )
 
 
+def partition_storm_plan(seed=0, nodes=4, validators=64) -> ScenarioPlan:
+    """Combined phases: the network PARTITIONS in the middle of an
+    ongoing equivocation storm (the storm keeps firing on both sides of
+    the split), then heals with the storm still running, then recovers.
+    The no-Byzantine-import invariant must hold on every side and the
+    slashers must still detect the proposer equivocation."""
+    spe = _spe()
+    return ScenarioPlan(
+        name="partition-storm",
+        seed=seed,
+        node_count=nodes,
+        validator_count=validators,
+        attach_slashers=True,
+        phases=(
+            Phase("baseline", slots=spe),
+            Phase("storm", slots=spe, equivocate_every=2, forge_every=4),
+            Phase(
+                "split-during-storm",
+                slots=spe,
+                partition=(
+                    tuple(range(nodes // 2)),
+                    tuple(range(nodes // 2, nodes)),
+                ),
+                equivocate_every=2,
+                forge_every=4,
+                conflicting_atts_every=4,
+            ),
+            Phase(
+                "heal-during-storm",
+                slots=2 * spe,
+                heal=True,
+                equivocate_every=3,
+            ),
+            Phase("recovery", slots=2 * spe),
+        ),
+        slo=SLO(
+            finality_min_epoch=3,
+            expect_proposer_slashings=True,
+            observed_delay_p95_s=6.0,
+            max_retry_attempts=100,
+            max_breaker_transitions=50,
+            max_bisection_calls=100,
+        ),
+    )
+
+
+def crash_nonfinality_plan(seed=0, nodes=4, validators=64) -> ScenarioPlan:
+    """Combined phases: a node crashes DURING long non-finality — the
+    CrashPlan is re-armed mid-phase (crash_arm_at) while 40% of
+    validators are withheld, so the WAL-recovery reopen happens against a
+    swollen hot DB, and the eventual finality jump migrates through
+    sub-batched freezer windows on a store that just replayed its
+    journal."""
+    spe = _spe()
+    return ScenarioPlan(
+        name="crash-nonfinality",
+        seed=seed,
+        node_count=nodes,
+        validator_count=validators,
+        migration_chunk_slots=spe,
+        phases=(
+            Phase("baseline", slots=spe),
+            Phase(
+                "stall-crash",
+                slots=3 * spe,
+                withhold_fraction=0.4,
+                crash_node=1,
+                crash_after_ops=23,
+                crash_action="after",
+                # re-arm one epoch INTO the stall: the kill lands while
+                # justification is already stuck
+                crash_arm_at=spe,
+            ),
+            Phase("recovery", slots=4 * spe),
+        ),
+        slo=SLO(
+            finality_min_epoch=5,
+            observed_delay_p95_s=6.0,
+            max_retry_attempts=100,
+            max_breaker_transitions=50,
+            max_bisection_calls=100,
+        ),
+    )
+
+
+def churn_backfill_plan(seed=0, nodes=4, validators=64) -> ScenarioPlan:
+    """Combined phases: fresh nodes join mid-storm and must backfill
+    through range sync WHILE transport faults ramp up mid-phase
+    (FaultPlan.set_rates via rates_at) — the retry/breaker budget is the
+    SLO under test."""
+    spe = _spe()
+    return ScenarioPlan(
+        name="churn-backfill",
+        seed=seed,
+        node_count=nodes,
+        validator_count=validators,
+        attach_slashers=True,
+        phases=(
+            Phase("baseline", slots=2 * spe),
+            Phase(
+                "join-during-storm",
+                slots=2 * spe,
+                join_nodes=2,
+                equivocate_every=3,
+                error_rate=0.05,
+                # ramp the fault plan mid-phase, then calm it before the
+                # phase ends so recovery starts from a clean transport
+                rates_at=((spe // 2, 0.15, 0.10), (spe + spe // 2, 0.0, 0.0)),
+            ),
+            Phase("recovery", slots=2 * spe),
+        ),
+        slo=SLO(
+            finality_min_epoch=3,
+            observed_delay_p95_s=6.0,
+            max_retry_attempts=400,
+            max_breaker_transitions=80,
+            max_bisection_calls=100,
+        ),
+    )
+
+
+def byzantine_vc_plan(seed=0, nodes=4, validators=64) -> ScenarioPlan:
+    """Byzantine validator clients drive slashable duties through the
+    REAL signing path: double proposals and conflicting aggregate votes
+    in the first byz phase, surround votes plus equivocating aggregates
+    once justification has advanced. Slashers must detect BOTH slashing
+    families, speculation must never confirm a byz aggregate by lookup,
+    and the chain must keep finalizing."""
+    spe = _spe()
+    return ScenarioPlan(
+        name="byzantine-vc",
+        seed=seed,
+        node_count=nodes,
+        validator_count=validators,
+        attach_slashers=True,
+        speculate=True,
+        phases=(
+            Phase("baseline", slots=2 * spe),
+            Phase(
+                "byz-equivocate",
+                slots=2 * spe,
+                byz=ByzPlan(
+                    fraction=0.25,
+                    every=2,
+                    double_propose=True,
+                    conflicting_votes=True,
+                ),
+            ),
+            # surround needs an earlier honest vote with source >= 1 from
+            # the same validator, hence the second byz phase runs after
+            # justification has advanced
+            Phase(
+                "byz-surround",
+                slots=2 * spe,
+                byz=ByzPlan(
+                    fraction=0.25,
+                    every=2,
+                    double_propose=False,
+                    conflicting_votes=False,
+                    surround_votes=True,
+                    equivocating_aggregates=True,
+                ),
+            ),
+            Phase("recovery", slots=2 * spe),
+        ),
+        slo=SLO(
+            finality_min_epoch=4,
+            expect_proposer_slashings=True,
+            expect_attester_slashings=True,
+            observed_delay_p95_s=6.0,
+            max_retry_attempts=100,
+            max_breaker_transitions=50,
+            max_bisection_calls=100,
+        ),
+    )
+
+
+def serving_chaos_plan(seed=0, nodes=4, validators=64) -> ScenarioPlan:
+    """Serving under chaos: node 0 fronts a real BeaconApiServer while
+    the network splits and a storm runs; a seeded HTTP mix replays after
+    every phase (mid-partition included) and the serving SLOs —
+    validator-lane immunity, head-root cache consistency after the
+    heal-reorg, SSE delivery — are end-of-run checks. Node 0 is never
+    crashed or churned (the serving anchor contract)."""
+    spe = _spe()
+    return ScenarioPlan(
+        name="serving-chaos",
+        seed=seed,
+        node_count=nodes,
+        validator_count=validators,
+        attach_slashers=True,
+        serving=True,
+        phases=(
+            Phase("baseline", slots=spe),
+            Phase(
+                "split-storm",
+                slots=spe,
+                partition=(
+                    tuple(range(nodes // 2)),
+                    tuple(range(nodes // 2, nodes)),
+                ),
+                equivocate_every=2,
+            ),
+            Phase("heal", slots=3 * spe, heal=True),
+        ),
+        slo=SLO(
+            finality_min_epoch=2,
+            observed_delay_p95_s=6.0,
+            max_retry_attempts=100,
+            max_breaker_transitions=50,
+            max_bisection_calls=100,
+        ),
+    )
+
+
 PLANS = {
     "partition": partition_plan,
     "churn": churn_plan,
@@ -700,4 +1151,9 @@ PLANS = {
     "equivocation-storm-speculate": equivocation_storm_speculate_plan,
     "long-nonfinality": long_nonfinality_plan,
     "crash-recovery": crash_recovery_plan,
+    "partition-storm": partition_storm_plan,
+    "crash-nonfinality": crash_nonfinality_plan,
+    "churn-backfill": churn_backfill_plan,
+    "byzantine-vc": byzantine_vc_plan,
+    "serving-chaos": serving_chaos_plan,
 }

@@ -35,10 +35,16 @@ class Simulator:
         attach_slashers: bool = False,
         migration_chunk_slots: int | None = None,
         speculate: bool = False,
+        bus=None,
     ):
         self.preset = preset
         self.spec = spec or ChainSpec.interop()
-        self.raw_bus = MessageBus()
+        # transport seat: the default in-process MessageBus, or an
+        # injected bus-compatible fabric (harness wire-transport mode
+        # runs the same plans over WireBus sockets via WireFabric)
+        self.raw_bus = bus if bus is not None else MessageBus()
+        if hasattr(self.raw_bus, "_bind_preset"):
+            self.raw_bus._bind_preset(preset)
         self.bus = self.raw_bus
         self.fault_plan = fault_plan
         if fault_plan is not None:
@@ -70,6 +76,27 @@ class Simulator:
         # NEVER be imported by an honest node via gossip
         self.equivocation_roots: list[bytes] = []
         self.forged_roots: list[bytes] = []
+        # Byzantine validator clients (validator_client/byzantine.py):
+        # a per-phase roster of homed validators whose duties run through
+        # a slashing-protection-bypassing store. Counters tally EMITTED
+        # slashable messages; overrides accumulate the protection layer's
+        # refusals across phase rosters.
+        self.byz = None
+        self.byz_counts = {
+            "double_proposals": 0,
+            "conflicting_vote_pairs": 0,
+            "surround_votes": 0,
+            "equivocating_aggregates": 0,
+            "honest_votes_gossiped": 0,
+        }
+        self.byz_overrides: list[tuple[str, int, str]] = []
+        # tree roots of every byz-emitted aggregate ATTESTATION: the
+        # speculation layer must never confirm one of these by lookup
+        self.byz_aggregate_roots: list[bytes] = []
+        # group -> homed validators is recomputed per group per slot;
+        # the scan is O(validators) and dominated hundred-node profiles
+        # (tools/scenario_profile.py), so memoize on the group's peer set
+        self._group_validators_cache: dict[frozenset, set[int]] = {}
         # current split as node groups (None = fully connected)
         self._partition: list[list[NetworkNode]] | None = None
         for _ in range(node_count):
@@ -232,10 +259,51 @@ class Simulator:
         return [g for g in groups if g]
 
     def _group_validators(self, group) -> set[int]:
-        peers = {n.peer_id for n in group}
-        return {
-            v for v, home in self.validator_home.items() if home in peers
-        }
+        """Validators homed on this group's peers. Cached per peer set
+        (validator_home is fixed at construction); callers must treat
+        the result as read-only."""
+        peers = frozenset(n.peer_id for n in group)
+        cached = self._group_validators_cache.get(peers)
+        if cached is None:
+            cached = {
+                v for v, home in self.validator_home.items() if home in peers
+            }
+            self._group_validators_cache[peers] = cached
+        return cached
+
+    # -- Byzantine validator clients (validator_client/byzantine.py) ---------
+
+    def set_byz_plan(self, plan, rng) -> None:
+        """Install a fresh Byzantine roster for a phase: sample
+        `plan.fraction` of each node's HOMED validators (per node, so
+        every partition side gets adversaries), enrolled into a shared
+        slashing-protection-bypassing store. `None` (or an inactive
+        plan) clears the roster; the outgoing roster's protection
+        overrides are kept for the end-of-run report."""
+        from ..validator_client.byzantine import ByzRoster
+
+        if self.byz is not None:
+            self.byz_overrides.extend(self.byz.store.overrides)
+        self.byz = None
+        if plan is None or not plan.active():
+            return
+        by_home: dict[str, list[int]] = {}
+        for v in range(self.validator_count):
+            by_home.setdefault(self.validator_home[v], []).append(v)
+        roster = ByzRoster(plan, self.preset, self.spec)
+        for home in sorted(by_home):
+            vs = sorted(by_home[home])
+            k = int(len(vs) * plan.fraction)
+            for v in sorted(rng.sample(vs, k)):
+                roster.enroll(v, bytes(self.genesis.validators[v].pubkey))
+        if roster.members:
+            self.byz = roster
+
+    def total_byz_overrides(self) -> int:
+        n = len(self.byz_overrides)
+        if self.byz is not None:
+            n += len(self.byz.store.overrides)
+        return n
 
     # -- slot driving --------------------------------------------------------
 
@@ -255,6 +323,7 @@ class Simulator:
         active_validators=None,
         equivocate: bool = False,
         forge: bool = False,
+        byzantine: bool = False,
     ) -> None:
         """One slot of the synthetic network, per partition group: the
         group holding the proposer's home node produces and gossips a
@@ -263,17 +332,25 @@ class Simulator:
         proposes/attests (long-non-finality withholding); `equivocate`
         gossips a second conflicting proposal and `forge` an invalid one
         (equivocation-storm phases), both relayed by a synthetic
-        Byzantine peer that is not a real node."""
+        Byzantine peer that is not a real node; `byzantine` drives the
+        installed ByzRoster's slashable duties through the real
+        validator-store signing path (set_byz_plan)."""
         self.tick(slot)
         for group in self._node_groups():
-            self._produce_for_group(
+            ctx = self._produce_for_group(
                 group, slot, attest, active_validators, equivocate, forge
             )
+            if byzantine and self.byz is not None and ctx is not None:
+                self._run_byz_duties(group, slot, ctx)
         self.drain()
 
     def _produce_for_group(
         self, group, slot, attest, active_validators, equivocate, forge
-    ) -> None:
+    ) -> dict | None:
+        """Returns the group's production context (advanced state,
+        proposer, home node, attestations, the published block or None
+        on an empty slot) for the byz duty driver; None only when the
+        home node crashed mid-publish."""
         from ..state_transition import (
             clone_state,
             get_beacon_proposer_index,
@@ -288,9 +365,18 @@ class Simulator:
         proposer = get_beacon_proposer_index(adv, self.preset, self.spec)
         allowed = self._group_validators(group)
         if active_validators is not None:
-            allowed &= set(active_validators)
+            allowed = allowed & set(active_validators)
+        ctx = {
+            "adv": adv,
+            "proposer": proposer,
+            "allowed": allowed,
+            "parent_state": parent_state,
+            "home": leader,
+            "atts": [],
+            "signed": None,
+        }
         if proposer not in allowed:
-            return  # the proposer is on the other side / offline: empty slot
+            return ctx  # the proposer is on the other side / offline
         home = next(
             (
                 n
@@ -303,11 +389,13 @@ class Simulator:
             # the proposer's home has not reconciled the group's head yet
             # (fresh heal/rejoin): the leader publishes on its behalf
             home = leader
+        ctx["home"] = home
         atts = []
         if attest and slot > 1:
             atts = self.producer.attestations_for_slot(
                 adv, slot - 1, validators=allowed
             )
+        ctx["atts"] = atts
         signed, _ = self.producer.produce_block(
             slot, atts, base_state=parent_state
         )
@@ -315,7 +403,8 @@ class Simulator:
             home.publish_block(signed)
         except InjectedCrash:
             self.mark_dead(home)
-            return
+            return None
+        ctx["signed"] = signed
         if self.speculate and atts:
             # gossip a real SignedAggregateAndProof so the aggregate
             # verification path (and with it the precompute hook) runs
@@ -351,6 +440,235 @@ class Simulator:
             bad.message.state_root = b"\x66" * 32
             self.forged_roots.append(bad.message.tree_hash_root())
             self.raw_bus.publish("byz", home._topic_block, bad)
+        return ctx
+
+    # -- Byzantine duty driving (validator_client/byzantine.py) --------------
+
+    def _run_byz_duties(self, group, slot, ctx) -> None:
+        """Drive this group's Byzantine validators through the REAL
+        validator-store signing path (domains, signing roots, the
+        slashing-DB gate — bypassed and audited). Slashable artifacts
+        are GOSSIPED by a colluding relay peer ("byzvc") sitting on the
+        group's side of any split: a byz VC talks to the network through
+        its relay, never through an honest node's import path, so the
+        no-byz-import invariant audits exactly the gossip boundary."""
+        plan = self.byz.plan
+        anchor = ctx["home"]
+        # place the relay on this group's side (no-op when unpartitioned)
+        self.raw_bus.join_group("byzvc", anchor.peer_id)
+        if (
+            plan.double_propose
+            and ctx["signed"] is not None
+            and ctx["proposer"] in self.byz
+        ):
+            self._byz_double_propose(slot, ctx)
+        if slot > 2:
+            seats = self._byz_committee_seats(group, slot, ctx["adv"])
+            if seats and (plan.conflicting_votes or plan.surround_votes):
+                self._byz_votes(slot, ctx, seats)
+            if seats and plan.equivocating_aggregates:
+                self._byz_equivocating_aggregate(slot, ctx, seats)
+
+    def _byz_committee_seats(self, group, slot, adv):
+        """(position, validator) byz seats in committee 0 of slot-1
+        homed in this group."""
+        from ..state_transition import ConsensusContext
+        from ..types import compute_epoch_at_slot
+
+        att_slot = slot - 1
+        ctxt = ConsensusContext(self.preset, self.spec)
+        committee = ctxt.committee_cache(
+            adv, compute_epoch_at_slot(att_slot, self.preset)
+        ).get_beacon_committee(att_slot, 0)
+        peers = {n.peer_id for n in group}
+        return [
+            (pos, v)
+            for pos, v in enumerate(committee)
+            if v in self.byz and self.validator_home.get(v) in peers
+        ]
+
+    def _byz_sign_aggregate(self, aggregator: int, attestation, adv):
+        """SignedAggregateAndProof through the byz store's real
+        selection-proof + aggregate-and-proof signing path."""
+        from ..types import types_for
+
+        t = types_for(self.preset)
+        pk = self.byz.pubkey_of(aggregator)
+        proof = self.byz.store.sign_selection_proof(
+            pk, attestation.data.slot, adv
+        )
+        msg = t.AggregateAndProof(
+            aggregator_index=aggregator,
+            aggregate=attestation,
+            selection_proof=proof.to_bytes(),
+        )
+        sig = self.byz.store.sign_aggregate_and_proof(pk, msg, adv)
+        return t.SignedAggregateAndProof(
+            message=msg, signature=sig.to_bytes()
+        )
+
+    def _byz_double_propose(self, slot, ctx) -> None:
+        """A SECOND distinct proposal for the slot, signed by the byz
+        proposer through the store: the honest proposal is signed first
+        (the real duty, cleanly recorded), so the double is exactly the
+        message the slashing DB refuses — the refusal is overridden and
+        audited. Honest nodes must IGNORE the double via gossip and
+        their slashers must emit a ProposerSlashing."""
+        store = self.byz.store
+        proposer = ctx["proposer"]
+        pk = self.byz.pubkey_of(proposer)
+        store.sign_block(pk, ctx["signed"].message, ctx["adv"])
+        double, _ = self.producer.produce_block(
+            slot,
+            ctx["atts"],
+            base_state=ctx["parent_state"],
+            graffiti=b"byz-vc-double",
+        )
+        sig = store.sign_block(pk, double.message, ctx["adv"])
+        double.signature = sig.to_bytes()
+        self.equivocation_roots.append(double.message.tree_hash_root())
+        self.raw_bus.publish("byzvc", ctx["home"]._topic_block, double)
+        self.byz_counts["double_proposals"] += 1
+
+    def _byz_votes(self, slot, ctx, seats) -> None:
+        """Per-seat slashable voting on the attestation subnet. Gossip
+        dedup admits ONE unaggregated vote per (target epoch, attester),
+        so each byz seat gossips a single vote per epoch: an honest one
+        while justification is young (building the history a surround
+        needs), then — with surround_votes on — a vote whose source is
+        dragged back to genesis, surrounding its own earlier honest vote.
+        Conflicting DOUBLE votes ride the aggregate lane instead
+        (_byz_conflicting_aggregates): two distinct byz aggregators pass
+        the per-aggregator dedup where a second subnet vote cannot."""
+        from ..types import types_for
+        from ..types.containers import AttestationData, Checkpoint
+        from .message_bus import topic_name
+
+        adv = ctx["adv"]
+        att_slot = slot - 1
+        plan = self.byz.plan
+        store = self.byz.store
+        t = types_for(self.preset)
+        anchor = ctx["home"]
+        topic = topic_name("beacon_attestation", anchor.fork_digest, 0)
+        genesis_root = bytes(anchor.chain.genesis_block_root)
+        honest = self.producer.attestation_data_for(adv, att_slot, 0)
+        for pos, v in seats:
+            pk = self.byz.pubkey_of(v)
+            if plan.surround_votes and honest.source.epoch >= 1:
+                # source dragged back to genesis: (0, target) surrounds
+                # this validator's own earlier honest (>=1, target') vote
+                data = AttestationData(
+                    slot=honest.slot,
+                    index=honest.index,
+                    beacon_block_root=bytes(honest.beacon_block_root),
+                    source=Checkpoint(epoch=0, root=genesis_root),
+                    target=Checkpoint(
+                        epoch=honest.target.epoch,
+                        root=bytes(honest.target.root),
+                    ),
+                )
+                self.byz_counts["surround_votes"] += 1
+            else:
+                data = honest
+                self.byz_counts["honest_votes_gossiped"] += 1
+            sig = store.sign_attestation(pk, data, adv)
+            att = self.producer.make_unaggregated(adv, att_slot, 0, pos)
+            att = t.Attestation(
+                aggregation_bits=att.aggregation_bits,
+                data=data,
+                signature=sig.to_bytes(),
+            )
+            self.raw_bus.publish("byzvc", topic, att)
+        if plan.conflicting_votes and len(seats) >= 2:
+            self._byz_conflicting_aggregates(
+                slot, ctx, seats, honest, genesis_root
+            )
+
+    def _byz_conflicting_aggregates(
+        self, slot, ctx, seats, honest, genesis_root
+    ) -> None:
+        """The conflicting DOUBLE vote: the group's byz seats vote two
+        different heads for the same (slot, target), each variant relayed
+        by a DIFFERENT byz aggregator — the (epoch, aggregator) gossip
+        dedup admits both, every honest slasher sees both verified
+        indexed attestations, and the shared attesting indices are a
+        double-vote detection (AttesterSlashing)."""
+        from ..crypto.bls import INFINITY_SIGNATURE
+        from ..state_transition import ConsensusContext
+        from ..types import compute_epoch_at_slot, types_for
+        from ..types.containers import AttestationData, Checkpoint
+
+        adv = ctx["adv"]
+        att_slot = slot - 1
+        store = self.byz.store
+        t = types_for(self.preset)
+        ctxt = ConsensusContext(self.preset, self.spec)
+        committee = ctxt.committee_cache(
+            adv, compute_epoch_at_slot(att_slot, self.preset)
+        ).get_beacon_committee(att_slot, 0)
+        members = {v for _, v in seats}
+        bits = tuple(v in members for v in committee)
+        conflict = AttestationData(
+            slot=honest.slot,
+            index=honest.index,
+            beacon_block_root=genesis_root,
+            source=Checkpoint(
+                epoch=honest.source.epoch, root=bytes(honest.source.root)
+            ),
+            target=Checkpoint(
+                epoch=honest.target.epoch, root=bytes(honest.target.root)
+            ),
+        )
+        # every seat signs the conflicting data through the store: the
+        # slashing DB refuses each double vote; refusals are overridden
+        # and audited (the honest variant was signed in _byz_votes)
+        for _, v in seats:
+            store.sign_attestation(self.byz.pubkey_of(v), conflict, adv)
+        topic = ctx["home"]._topic_aggregate
+        # aggregators from the tail of the seat list: the speculation
+        # path's honest aggregator is the committee head, and one
+        # (epoch, aggregator) dedup slot must not eat the byz pair
+        agg_honest, agg_conflict = seats[-1][1], seats[-2][1]
+        for aggregator, data in (
+            (agg_honest, honest),
+            (agg_conflict, conflict),
+        ):
+            att = t.Attestation(
+                aggregation_bits=bits, data=data, signature=INFINITY_SIGNATURE
+            )
+            signed = self._byz_sign_aggregate(aggregator, att, adv)
+            self.byz_aggregate_roots.append(att.tree_hash_root())
+            self.raw_bus.publish("byzvc", topic, signed)
+        self.byz_counts["conflicting_vote_pairs"] += 1
+
+    def _byz_equivocating_aggregate(self, slot, ctx, seats) -> None:
+        """ONE byz aggregator signs TWO distinct aggregates for the same
+        (slot, committee): full honest participation bits, then a
+        single-seat subset of the same data. Honest nodes verify and
+        import at most one ((epoch, aggregator) dedup IGNOREs the
+        second) and speculation must never confirm either by lookup."""
+        from ..types import types_for
+
+        adv = ctx["adv"]
+        att_slot = slot - 1
+        t = types_for(self.preset)
+        full = self.producer.attestations_for_slot(adv, att_slot)[0]
+        pos, aggregator = seats[-1]
+        bits = tuple(
+            i == pos for i in range(len(list(full.aggregation_bits)))
+        )
+        subset = t.Attestation(
+            aggregation_bits=bits,
+            data=full.data,
+            signature=bytes(full.signature),
+        )
+        topic = ctx["home"]._topic_aggregate
+        for att in (full, subset):
+            signed = self._byz_sign_aggregate(aggregator, att, adv)
+            self.byz_aggregate_roots.append(att.tree_hash_root())
+            self.raw_bus.publish("byzvc", topic, signed)
+        self.byz_counts["equivocating_aggregates"] += 1
 
     def publish_conflicting_attestations(self, slot: int) -> None:
         """A Byzantine double vote: two attestations from the same

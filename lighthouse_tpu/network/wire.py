@@ -259,11 +259,31 @@ class WireCodec:
             from ..types.containers import SyncCommitteeMessage
 
             return SyncCommitteeMessage.from_ssz_bytes(raw)
+        # operation gossip (types/topics.rs pubsub kinds): the scenario
+        # wire fabric routes EVERY node topic over sockets, so the codec
+        # must cover the op lanes the in-process bus carried for free
+        if kind == "proposer_slashing":
+            from ..types.containers import ProposerSlashing
+
+            return ProposerSlashing.from_ssz_bytes(raw)
+        if kind == "attester_slashing":
+            return t.AttesterSlashing.from_ssz_bytes(raw)
+        if kind == "voluntary_exit":
+            from ..types.containers import SignedVoluntaryExit
+
+            return SignedVoluntaryExit.from_ssz_bytes(raw)
         raise ValueError(f"unknown gossip kind in topic {topic}")
 
     # -- req/resp -------------------------------------------------------------
 
     def encode_request(self, protocol: str, payload) -> bytes:
+        if "fabric_gossip" in protocol:
+            # scenario-fabric delivery: a gossip message pushed as a
+            # SYNCHRONOUS req/resp exchange (topic-prefixed ssz_snappy)
+            # so the sender observes completion — the determinism seam
+            # that lets wire-transport scenarios replay bit-identically
+            topic = payload["topic"].encode()
+            return struct.pack(">H", len(topic)) + topic + payload["data"]
         if "status" in protocol:
             return b""  # our Router's status handler takes no input
         if "by_range" in protocol:
@@ -285,6 +305,13 @@ class WireCodec:
         raise ValueError(f"unknown protocol {protocol}")
 
     def decode_request(self, protocol: str, data: bytes):
+        if "fabric_gossip" in protocol:
+            (tlen,) = struct.unpack_from(">H", data, 0)
+            topic = data[2 : 2 + tlen].decode()
+            return {
+                "topic": topic,
+                "payload": self.decode_gossip(topic, data[2 + tlen :]),
+            }
         if "status" in protocol:
             return {}
         if "by_range" in protocol:
@@ -298,6 +325,8 @@ class WireCodec:
         raise ValueError(f"unknown protocol {protocol}")
 
     def encode_response(self, protocol: str, result) -> bytes:
+        if "fabric_gossip" in protocol:
+            return b""  # delivery ack carries no body
         if "status" in protocol:
             msg = StatusMessage(
                 fork_digest=bytes(result["fork_digest"]),
@@ -313,6 +342,8 @@ class WireCodec:
         return _chunks_encode([_ssz_snappy(b) for b in result])
 
     def decode_response(self, protocol: str, data: bytes):
+        if "fabric_gossip" in protocol:
+            return None
         chunks = _chunks_decode(data)
         if "status" in protocol:
             msg = StatusMessage.from_ssz_bytes(decompress(chunks[0]))

@@ -63,6 +63,11 @@ class SpeculationSubsystem:
             queue_wait_p95_max=queue_wait_p95_max,
         )
         self._last_refreshed_epoch: int | None = None
+        # audit trail for the scenario harness: tree roots of every
+        # attestation accepted by CONFIRM-BY-LOOKUP (the only speculation
+        # outcome that skips re-verification). The Byzantine-VC scenarios
+        # counter-assert that no byz-emitted aggregate ever lands here.
+        self.confirmed_roots: list[bytes] = []
 
     # -- precompute refresh (epoch boundary / startup / reorg) ---------------
 
@@ -150,6 +155,7 @@ class SpeculationSubsystem:
             entry.shuffling_key,
             bytes(attestation.signature),
         ):
+            self.confirmed_roots.append(bytes(attestation.tree_hash_root()))
             return None
         pk = self.precompute.aggregate_pubkey(entry, bits)
         return SignatureSet(ind_set.signature, [pk], ind_set.message)

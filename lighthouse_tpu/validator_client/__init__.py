@@ -3,6 +3,12 @@ duty services, signing store with slashing protection, beacon-node
 fallback, doppelganger protection."""
 
 from .beacon_node import InProcessBeaconNode  # noqa: F401
+from .byzantine import (  # noqa: F401
+    ByzPlan,
+    ByzRoster,
+    ByzantineValidatorStore,
+    PlaceholderKeystore,
+)
 from .keymanager import KeymanagerApi, KeymanagerServer  # noqa: F401
 from .services import (  # noqa: F401
     BeaconNodeFallback,

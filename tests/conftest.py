@@ -72,6 +72,16 @@ def pytest_configure(config):
     )
     config.addinivalue_line(
         "markers",
+        "fuzz: seeded scenario-plan fuzzing (harness/fuzz.py) — corpus "
+        "replay runs in tier-1, the budgeted search rides the fuzz CI job",
+    )
+    config.addinivalue_line(
+        "markers",
+        "wire: scenario runs over the real wire transport (length-framed "
+        "sockets, snappy frames, SSZ) instead of the in-memory bus",
+    )
+    config.addinivalue_line(
+        "markers",
         "kernels: Pallas kernel parity matrix (interpret mode on CPU); "
         "the fused tower/Miller kernels compile slowly in interpret "
         "mode, so these also carry `slow` and run in the dedicated "

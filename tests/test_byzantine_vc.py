@@ -1,0 +1,178 @@
+"""Byzantine validator clients (validator_client/byzantine.py).
+
+Unit surface: `ByzantineValidatorStore` still runs the REAL slashing
+protection gate on every signing request, records each `NotSafe` refusal
+to its audit trail, and then signs anyway — the malicious-operator model
+where the refusal is patched out of the client but the database can
+still prove what an honest client would have refused.
+
+Scenario surface: the `byzantine-vc` catalogue plan drives slashable
+behavior through the real duty-signing path and must satisfy the full
+acceptance contract — invariants hold, the slasher finds BOTH slashing
+families, speculation never confirms a byz aggregate by lookup, and the
+run replays bit-identically.
+"""
+
+from __future__ import annotations
+
+import pytest
+
+from lighthouse_tpu.crypto.bls import set_backend
+from lighthouse_tpu.types import ChainSpec, MINIMAL, interop_genesis_state, types_for
+from lighthouse_tpu.types.containers import AttestationData, Checkpoint
+from lighthouse_tpu.validator_client import (
+    ByzPlan,
+    ByzantineValidatorStore,
+    NotSafe,
+    PlaceholderKeystore,
+    ValidatorStore,
+)
+
+SPE = MINIMAL.slots_per_epoch
+SPEC = ChainSpec.interop()
+PK = b"\xab" * 48
+
+
+@pytest.fixture(autouse=True)
+def fake_crypto():
+    set_backend("fake")
+    yield
+    set_backend("jax_tpu")
+
+
+def _att(target_epoch: int, root: bytes, source_epoch: int = 0) -> AttestationData:
+    return AttestationData(
+        slot=target_epoch * SPE,
+        index=0,
+        beacon_block_root=root,
+        source=Checkpoint(epoch=source_epoch, root=bytes(32)),
+        target=Checkpoint(epoch=target_epoch, root=root),
+    )
+
+
+class TestBypassAudit:
+    @staticmethod
+    def _store() -> ByzantineValidatorStore:
+        store = ByzantineValidatorStore(MINIMAL, SPEC)
+        store.add_validator(PlaceholderKeystore(PK), validator_index=0)
+        return store
+
+    def test_double_proposal_overridden_and_audited(self):
+        store = self._store()
+        state = interop_genesis_state(4, MINIMAL, SPEC)
+        t = types_for(MINIMAL)
+        a = t.BeaconBlock(slot=5, proposer_index=0)
+        b = t.BeaconBlock(slot=5, proposer_index=0, state_root=b"\x42" * 32)
+        store.sign_block(PK, a, state)
+        assert store.overrides == []  # first proposal is safe
+        sig = store.sign_block(PK, b, state)  # honest client refuses here
+        assert sig is not None
+        kind, slot, reason = store.overrides[0]
+        assert (kind, slot) == ("block", 5)
+        assert reason  # the NotSafe message is preserved verbatim
+
+    def test_conflicting_vote_overridden_and_audited(self):
+        store = self._store()
+        state = interop_genesis_state(4, MINIMAL, SPEC)
+        store.sign_attestation(PK, _att(1, b"\xaa" * 32), state)
+        store.sign_attestation(PK, _att(1, b"\xbb" * 32), state)
+        assert [(k, e) for k, e, _ in store.overrides] == [("attestation", 1)]
+
+    def test_surround_vote_overridden_and_audited(self):
+        store = self._store()
+        state = interop_genesis_state(4, MINIMAL, SPEC)
+        store.sign_attestation(PK, _att(5, b"\xaa" * 32, source_epoch=2), state)
+        store.sign_attestation(PK, _att(6, b"\xbb" * 32, source_epoch=1), state)
+        assert [(k, e) for k, e, _ in store.overrides] == [("attestation", 6)]
+
+    def test_honest_store_still_refuses_the_same_sequence(self):
+        """The bypass lives ONLY in the byzantine subclass — the base
+        store refuses the identical conflicting vote."""
+        store = ValidatorStore(MINIMAL, SPEC)
+        store.add_validator(PlaceholderKeystore(PK), validator_index=0)
+        state = interop_genesis_state(4, MINIMAL, SPEC)
+        store.sign_attestation(PK, _att(1, b"\xaa" * 32), state)
+        with pytest.raises(NotSafe):
+            store.sign_attestation(PK, _att(1, b"\xbb" * 32), state)
+
+    def test_byz_plan_activity(self):
+        assert ByzPlan().active()
+        assert not ByzPlan(fraction=0.0).active()
+        assert not ByzPlan(
+            double_propose=False, conflicting_votes=False
+        ).active()
+
+
+@pytest.mark.scenario
+class TestByzantineScenarioTier1:
+    def test_small_byzantine_run_detects_and_audits(self):
+        """A 3-node byz phase through the real duty path: slashable
+        messages are produced (protection audit non-empty), the slasher
+        converts them into proposer slashings, no byz root is imported
+        (checked per slot inside run_scenario), and the chain still
+        finalizes after the byz validators go quiet."""
+        from lighthouse_tpu.harness.scenario import (
+            SLO,
+            Phase,
+            ScenarioPlan,
+            run_scenario,
+        )
+
+        plan = ScenarioPlan(
+            name="byz-small",
+            seed=6,
+            node_count=3,
+            validator_count=48,
+            attach_slashers=True,
+            phases=(
+                Phase("baseline", slots=SPE),
+                Phase(
+                    "byz",
+                    slots=2 * SPE,
+                    byz=ByzPlan(
+                        fraction=0.3,
+                        every=1,
+                        double_propose=True,
+                        conflicting_votes=True,
+                    ),
+                ),
+                Phase("settle", slots=3 * SPE, heal=True),
+            ),
+            slo=SLO(finality_min_epoch=2, expect_proposer_slashings=True),
+        )
+        report = run_scenario(plan).report
+        assert report["slo"]["failures"] == [], report["slo"]
+        byz = report["byzantine"]
+        assert byz["counts"]["double_proposals"] > 0
+        assert byz["protection_overrides"] > 0
+        assert report["proposer_slashings_found"] > 0
+        assert len(report["final_heads"]) == 1
+
+
+@pytest.mark.scenario
+@pytest.mark.slow
+class TestByzantineScenarioAcceptance:
+    def test_byzantine_vc_plan_full_contract(self):
+        """The catalogue plan: both behavior families across two phases,
+        both slashing families detected, the speculation counter-assert
+        structurally in force, and bit-identical replay."""
+        from lighthouse_tpu.harness.scenario import (
+            PLANS,
+            assert_bit_identical_replay,
+        )
+
+        r1, r2 = assert_bit_identical_replay(PLANS["byzantine-vc"]())
+        report = r1.report
+        assert report["slo"]["failures"] == [], report["slo"]
+        assert report["trace_sha256"] == r2.report["trace_sha256"]
+        counts = report["byzantine"]["counts"]
+        assert counts["double_proposals"] > 0
+        assert counts["conflicting_vote_pairs"] > 0
+        assert counts["surround_votes"] > 0
+        assert counts["equivocating_aggregates"] > 0
+        assert report["byzantine"]["protection_overrides"] > 0
+        assert report["byzantine"]["aggregates_emitted"] > 0
+        # both slashing families reached the slasher through gossip
+        assert report["proposer_slashings_found"] > 0
+        assert report["attester_slashings_found"] > 0
+        assert len(report["final_heads"]) == 1

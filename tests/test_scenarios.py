@@ -75,6 +75,24 @@ class TestTier1Scenario:
         b = run_scenario(small_partition_plan(seed=12))
         assert a.trace != b.trace
 
+    @pytest.mark.wire
+    def test_small_partition_over_wire_sockets(self):
+        """The same tier-1 plan with transport="wire": every gossip
+        message rides real length-framed sockets (snappy frames, SSZ
+        round-trips) through the WireFabric's synchronous delivery seam,
+        and the scenario — including the partition, which is enforced at
+        the fabric layer — passes the identical contract."""
+        import dataclasses
+
+        plan = dataclasses.replace(
+            small_partition_plan(), name="partition-wire", transport="wire"
+        )
+        report = run_scenario(plan).report
+        assert report["transport"] == "wire"
+        assert report["slo"]["failures"] == [], report["slo"]
+        assert report["finalized_epoch"] >= 1
+        assert len(report["final_heads"]) == 1
+
 
 class TestInvariantChecker:
     """Unit surface: the checker must actually catch violations."""
@@ -171,6 +189,20 @@ class TestScenarioMatrix:
             ), report["crash_recoveries"]
         if name == "long-nonfinality":
             assert report["finalized_epoch"] >= 5
+        if name == "partition-storm":
+            # the storm ran DURING the split and still got slashed
+            assert report["proposer_slashings_found"] > 0
+        if name == "crash-nonfinality":
+            # the crash armed MID-PHASE, during the stall
+            assert report["crash_recoveries"], "node never crashed"
+        if name == "byzantine-vc":
+            assert report["byzantine"]["protection_overrides"] > 0
+            assert report["attester_slashings_found"] > 0
+        if name == "serving-chaos":
+            srv = report["serving"]
+            assert srv is not None
+            assert srv["failures"] == [], srv["failures"]
+            assert srv["sse_head_events"] > 0
 
     @pytest.mark.speculate
     def test_equivocation_storm_with_speculation(self):

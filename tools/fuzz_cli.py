@@ -1,0 +1,109 @@
+"""Scenario-plan fuzzing CLI: seeded iterations under a wall-clock
+budget, greedy shrinking of any finding, corpus artifacts out.
+
+The library half (`lighthouse_tpu.harness.fuzz`) is purely seed-driven
+and wall-clock-free; this CLI owns the budget (tools/ sits outside the
+determinism lint surface) so CI can say "fuzz for five minutes" while a
+given --start-seed window stays exactly reproducible.
+
+Usage:
+    python -m tools.fuzz_cli --start-seed 0 --iterations 50 --budget-s 300
+    python -m tools.fuzz_cli --plant byz-gossip-imported --iterations 4 \
+        --corpus-dir tests/fuzz_corpus        # regenerate pinned repros
+
+Exit code is the number of findings (0 == clean), so a CI step fails
+exactly when the oracle caught something; minimized reproducers are
+written to --corpus-dir as fuzz-<seed>.json for triage and replay."""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import time
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("--start-seed", type=int, default=0)
+    ap.add_argument(
+        "--iterations",
+        type=int,
+        default=25,
+        help="max generate+evaluate rounds (budget may stop earlier)",
+    )
+    ap.add_argument(
+        "--budget-s",
+        type=float,
+        default=None,
+        help="wall-clock budget; no new iteration starts past it",
+    )
+    ap.add_argument(
+        "--plant",
+        default=None,
+        help="planted oracle bug (shrinker validation); omit for real runs",
+    )
+    ap.add_argument("--corpus-dir", default=None)
+    ap.add_argument(
+        "--no-shrink",
+        action="store_true",
+        help="report raw failing plans without minimizing",
+    )
+    args = ap.parse_args(argv)
+
+    from lighthouse_tpu.crypto.bls import set_backend
+    from lighthouse_tpu.harness import fuzz as fz
+
+    set_backend("fake")  # fuzz the harness + consensus logic, not pairings
+
+    t0 = time.monotonic()
+    findings = []
+    ran = 0
+    for i in range(args.iterations):
+        if args.budget_s is not None and time.monotonic() - t0 > args.budget_s:
+            break
+        seed = args.start_seed + i
+        plan = fz.generate_plan(seed)
+        reason = fz.evaluate(plan, plant=args.plant)
+        ran += 1
+        if reason is None:
+            continue
+        if not args.no_shrink:
+            plan, reason = fz.shrink(
+                plan, lambda p: fz.evaluate(p, plant=args.plant)
+            )
+        findings.append((seed, plan, reason))
+        if args.corpus_dir:
+            os.makedirs(args.corpus_dir, exist_ok=True)
+            fz.save_corpus_entry(
+                os.path.join(args.corpus_dir, f"fuzz-{seed}.json"),
+                plan,
+                reason,
+                args.plant,
+            )
+
+    print(
+        json.dumps(
+            {
+                "iterations_run": ran,
+                "iterations_requested": args.iterations,
+                "elapsed_s": round(time.monotonic() - t0, 1),
+                "plant": args.plant,
+                "findings": [
+                    {
+                        "seed": seed,
+                        "reason": reason,
+                        "phases": [p.name for p in plan.phases],
+                        "node_count": plan.node_count,
+                    }
+                    for seed, plan, reason in findings
+                ],
+            },
+            indent=1,
+        )
+    )
+    return len(findings)
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -1,0 +1,149 @@
+"""Per-slot scenario-harness overhead profile: where does a hundred-node
+simulated slot actually spend its time?
+
+The two known costs blocking thousand-peer sims are (a) bus fan-out —
+every publish walks every subscriber, so gossip cost is O(nodes) per
+message and O(nodes^2) per slot — and (b) per-group state clones —
+`_produce_for_group` clones + slot-advances the leader's head state once
+per partition group per slot. This tool instruments both (plus the
+group→homed-validators scan, whose memoization was landed off an earlier
+run of this profile), drives a real `Simulator` for a few slots at
+--nodes scale, and emits a JSON report of call counts, totals, and
+per-call means.
+
+Usage:
+    python -m tools.scenario_profile --nodes 100 --slots 8
+    python -m tools.scenario_profile --nodes 100 --uncached-groups  # A/B
+
+`--uncached-groups` disables the `_group_validators` memo so the win it
+bought is measurable in the same report (compare `group_validators`
+totals across the two runs).
+
+Wall-clock use is deliberate and confined to this tool (tools/ is
+outside the determinism lint surface): this is a measurement harness,
+not simulation logic."""
+
+from __future__ import annotations
+
+import argparse
+import json
+import time
+from collections import defaultdict
+
+
+def _instrument(obj, attr: str, bucket: dict):
+    """Wrap obj.attr with perf_counter accounting into bucket."""
+    inner = getattr(obj, attr)
+
+    def timed(*args, **kwargs):
+        t0 = time.perf_counter()
+        try:
+            return inner(*args, **kwargs)
+        finally:
+            bucket["calls"] += 1
+            bucket["total_s"] += time.perf_counter() - t0
+
+    setattr(obj, attr, timed)
+    return inner
+
+
+def profile(nodes: int, validators: int, slots: int, uncached_groups: bool) -> dict:
+    from lighthouse_tpu import state_transition
+    from lighthouse_tpu.crypto.bls import get_backend_name, set_backend
+    from lighthouse_tpu.network.simulator import Simulator
+    from lighthouse_tpu.types import MINIMAL, ChainSpec
+
+    buckets: dict[str, dict] = defaultdict(
+        lambda: {"calls": 0, "total_s": 0.0}
+    )
+
+    prior = get_backend_name()
+    set_backend("fake")  # profile harness overhead, not pairings
+    try:
+        t_build0 = time.perf_counter()
+        sim = Simulator(nodes, validators, MINIMAL, ChainSpec.interop())
+        build_s = time.perf_counter() - t_build0
+
+        # (a) bus fan-out: every gossip publish, across all topics
+        _instrument(sim.raw_bus, "publish", buckets["bus_publish"])
+        # (b) per-group state clones + slot advance (module attribute:
+        # _produce_for_group imports it at call time, so this wrapper is
+        # what the simulator executes)
+        orig_clone = _instrument(
+            state_transition, "clone_state", buckets["clone_state"]
+        )
+        # (c) the group->homed-validators scan (memoized; --uncached-groups
+        # empties the memo before every lookup for the A/B comparison)
+        inner_groups = sim._group_validators
+
+        def groups_timed(group):
+            if uncached_groups:
+                sim._group_validators_cache.clear()
+            t0 = time.perf_counter()
+            try:
+                return inner_groups(group)
+            finally:
+                buckets["group_validators"]["calls"] += 1
+                buckets["group_validators"]["total_s"] += (
+                    time.perf_counter() - t0
+                )
+        sim._group_validators = groups_timed
+
+        t_run0 = time.perf_counter()
+        for slot in range(1, slots + 1):
+            t_slot0 = time.perf_counter()
+            sim.run_slot(slot)
+            buckets["run_slot"]["calls"] += 1
+            buckets["run_slot"]["total_s"] += time.perf_counter() - t_slot0
+        run_s = time.perf_counter() - t_run0
+        heads = {n.chain.head_root.hex() for n in sim.nodes}
+    finally:
+        state_transition.clone_state = orig_clone
+        set_backend(prior)
+
+    report = {
+        "nodes": nodes,
+        "validators": validators,
+        "slots": slots,
+        "uncached_groups": uncached_groups,
+        "build_s": round(build_s, 4),
+        "run_s": round(run_s, 4),
+        "per_slot_s": round(run_s / max(1, slots), 4),
+        "heads_converged": len(heads) == 1,
+        "timings": {},
+    }
+    for name, b in sorted(buckets.items()):
+        report["timings"][name] = {
+            "calls": b["calls"],
+            "total_s": round(b["total_s"], 4),
+            "mean_ms": round(1000 * b["total_s"] / max(1, b["calls"]), 4),
+            "share_of_run": round(b["total_s"] / max(run_s, 1e-9), 4),
+        }
+    return report
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("--nodes", type=int, default=100)
+    ap.add_argument("--validators", type=int, default=200)
+    ap.add_argument("--slots", type=int, default=8)
+    ap.add_argument(
+        "--uncached-groups",
+        action="store_true",
+        help="disable the _group_validators memo (A/B the landed win)",
+    )
+    ap.add_argument("--out", default=None, help="write the JSON report here")
+    args = ap.parse_args(argv)
+    report = profile(
+        args.nodes, args.validators, args.slots, args.uncached_groups
+    )
+    text = json.dumps(report, indent=1)
+    if args.out:
+        with open(args.out, "w", encoding="utf-8") as f:
+            f.write(text + "\n")
+    print(text)
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -4,7 +4,8 @@ PY ?= python
 
 .PHONY: lint lint-baseline test test-lint test-chaos test-crash \
 	test-scenario test-serving test-speculate test-kernels \
-	test-fuzz fuzz bench-serving bench-speculate warm-compile
+	test-fuzz fuzz bench-serving bench-speculate bench-scale \
+	test-sharded warm-compile
 
 ## lint: AST consensus-safety & TPU-hazard pass (tools/lint, stdlib-only)
 lint:
@@ -87,6 +88,22 @@ bench-serving:
 bench-speculate:
 	BENCH_PLATFORM=cpu JAX_PLATFORMS=cpu $(PY) bench.py --speculate \
 		| tee bench-speculate.json
+
+## bench-scale: 2M-validator epoch transition on the simulated 4-device
+## mesh + sharded pubkey-table per-device bytes (one JSON line — the
+## artifact the CI sharded-state job uploads)
+bench-scale:
+	BENCH_PLATFORM=cpu JAX_PLATFORMS=cpu $(PY) bench.py --scale \
+		| tee bench-scale.json
+
+## test-sharded: the sharded-state differential matrix on a forced
+## 4-device mesh (the CI sharded-state job; in-suite tier-1 runs the
+## same file on the conftest 8-device mesh minus the slow chip-fault
+## test, which compiles the full verify_jit program)
+test-sharded:
+	JAX_PLATFORMS=cpu \
+	XLA_FLAGS=--xla_force_host_platform_device_count=4 \
+	$(PY) -m pytest tests/test_sharded_state.py -q -p no:cacheprovider
 
 ## warm-compile: AOT-compile every verifier shape bucket into ./datadir's
 ## persistent compile cache (deploy-time warm pass; `cli warm`)

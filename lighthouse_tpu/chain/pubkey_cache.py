@@ -116,3 +116,9 @@ class ValidatorPubkeyCache:
             table.import_new_pubkeys(self._pubkeys)
             self._table = table
         return self._table.device_table()
+
+    def gather(self, indices):
+        """Validator indices -> (..., 3, W) device limb rows, via the
+        table's (mesh-sharded) gather path."""
+        self.device_table()
+        return self._table.gather(indices)

@@ -508,15 +508,44 @@ def _mesh_eligible(n_b: int) -> bool:
     return bool(threshold) and n_b >= threshold and len(jax.devices()) > 1
 
 
-def _marshal_batch(sets, seed=None, groups=None):
+def _pack_index_batch(sets, n_b: int, k_b: int):
+    """The (n_b, k_b) validator-index / pubkey-count mask pack of one
+    fully table-tagged batch -- the host loop of the gather path, split
+    out so the pipeline can run it pre-marshal on the submit thread."""
+    idx = np.zeros((n_b, k_b), np.int32)
+    mask = np.zeros((n_b, k_b), bool)
+    for i, s in enumerate(sets):
+        for j, key in enumerate(s.pubkeys):
+            idx[i, j] = key.validator_index
+        mask[i, : len(s.pubkeys)] = True
+    return idx, mask
+
+
+def prepack_indices(sets):
+    """Pipeline pre-marshal hook: the gather path's (idx, mask) pack when
+    EVERY pubkey in the batch is tagged with the same device table, else
+    None (the batch will host-pack limb rows instead). Pure host work --
+    safe off the dispatch thread."""
+    for s in sets:
+        if not s.pubkeys or s.signature.point.inf:
+            return None
+    if _common_table(sets) is None:
+        return None
+    n_b = _bucket(len(sets))
+    k_b = _bucket(max(len(s.pubkeys) for s in sets))
+    return _pack_index_batch(sets, n_b, k_b)
+
+
+def _marshal_batch(sets, seed=None, groups=None, index_pack=None):
     """Host-side marshalling for one batch: shape bucketing, distinct-
     message grouping, limb packing (or device-table index gather),
     weights, and -- when the batch repeats messages -- the per-message
     aggregation grid for the mega-pairing path. Returns a `Marshalled`,
     or None when a structural check already decides the batch (empty
     pubkeys / infinity signature -> invalid, no device work). `groups`
-    is an optional precomputed `aggregation.MessageGroups` (the pipeline
-    computes it pre-marshal on the submit thread)."""
+    is an optional precomputed `aggregation.MessageGroups` and
+    `index_pack` an optional precomputed `prepack_indices` result (the
+    pipeline computes both pre-marshal on the submit thread)."""
     # host-side structural checks (cheap; device work is all-or-nothing)
     for s in sets:
         if not s.pubkeys or s.signature.point.inf:
@@ -576,18 +605,15 @@ def _marshal_batch(sets, seed=None, groups=None):
     if table is not None:
         # Steady-state marshaling (validator_pubkey_cache.rs:10-23):
         # host->device traffic is validator INDICES; limb rows are gathered
-        # from the device-resident table. The eager gather feeds the same
-        # warm verify_jit executable as the host-packed path.
+        # from the device-resident (possibly mesh-sharded) table. The
+        # eager gather feeds the same warm verify_jit executable as the
+        # host-packed path.
         metrics.BLS_GATHER_HITS.inc()
-        idx = np.zeros((n_b, k_b), np.int32)
-        mask = np.zeros((n_b, k_b), bool)
-        for i, s in enumerate(sets):
-            for j, key in enumerate(s.pubkeys):
-                idx[i, j] = key.validator_index
-            mask[i, : len(s.pubkeys)] = True
-        rows = jnp.take(
-            table.device_table(), jnp.asarray(idx), axis=0, mode="clip"
-        )
+        if index_pack is not None:
+            idx, mask = index_pack
+        else:
+            idx, mask = _pack_index_batch(sets, n_b, k_b)
+        rows = table.gather(idx)
         pk_dev = jnp.where(
             jnp.asarray(mask)[..., None, None], rows, jnp.asarray(_INF_G1)
         )
@@ -665,16 +691,17 @@ def _count_pairs(n_sets: int, pairs: int, aggregated: bool) -> None:
         metrics.BLS_AGGREGATED_BATCHES.inc()
 
 
-def dispatch_verify_signature_sets(sets, seed=None, groups=None):
+def dispatch_verify_signature_sets(sets, seed=None, groups=None, index_pack=None):
     """Async half of `verify_signature_sets`: marshal + enqueue, NO host
     sync. Returns a zero-dim device bool (materialise with `bool()`), or
     a plain python bool when a structural check or the monolith/sharded
     path already decided the batch. The pipeline (crypto/bls/pipeline.py)
     overlaps the next batch's marshalling with this batch's device work
-    and passes the message `groups` it computed pre-marshal.
+    and passes the message `groups` and gather `index_pack` it computed
+    pre-marshal.
     """
     with tracing.span("bls_marshal", sets=len(sets)):
-        mb = _marshal_batch(sets, seed=seed, groups=groups)
+        mb = _marshal_batch(sets, seed=seed, groups=groups, index_pack=index_pack)
     if mb is None:
         return False
 
@@ -740,11 +767,14 @@ def verify_signature_sets(sets, seed=None) -> bool:
 # The shape families a fresh node sees in steady state: gossip batches
 # (<= 64 sets, mostly distinct messages -> m_b == n_b, per-set staged
 # path) and aggregate/backfill mega-batches (repeated messages -> m_b
-# collapsed to the floor, aggregated path). k_b stays at the bucket
-# floor for the dominant 1-pubkey sets; operators with heavier committee
-# shapes pass their own bucket list to `warm_compile`.
+# collapsed to the floor, aggregated path). The 512 bucket sits at the
+# _shard_min_sets default, so on a multi-chip node it warms the MESH
+# bodies (grouped + per-set) the dispatcher routes mega-batches to.
+# k_b stays at the bucket floor for the dominant 1-pubkey sets;
+# operators with heavier committee shapes pass their own bucket list to
+# `warm_compile`.
 DEFAULT_WARM_BUCKETS: tuple = tuple(
-    sorted({(n_b, 4, m_b) for n_b in (4, 16, 64, 256) for m_b in (4, n_b)})
+    sorted({(n_b, 4, m_b) for n_b in (4, 16, 64, 256, 512) for m_b in (4, n_b)})
 )
 
 
@@ -754,26 +784,32 @@ def warm_compile(buckets=None, runner=None):
     so a fresh node never JITs during a slot.
 
     Each (n_b, k_b, m_b) bucket drives the SAME jitted entry points the
-    dispatcher routes to -- the aggregated grid path when message
-    aggregation is on and m_b < n_b, else the per-set staged path --
-    with structurally-valid all-padding batches (XLA compilation is
-    shape-keyed; values are irrelevant: padded rows hold projective
-    infinities and zero scalars exactly like real padding). Shapes are
-    scored and registered exactly like dispatched batches: cold shapes
-    count on tpu_compile_cache_misses_total and land in the persistent
-    registry after the executable exists, warm ones count hits. Per-
-    bucket wall seconds are published on tpu_warm_compile_seconds (and
-    returned) so deploys can budget the pass.
+    dispatcher routes to -- the sharded mesh bodies when the bucket sits
+    at/above the shard threshold on a multi-chip node (grouped when
+    message aggregation collapses m_b below n_b, per-set otherwise), the
+    aggregated grid path when message aggregation is on and m_b < n_b,
+    else the per-set staged path -- with structurally-valid all-padding
+    batches (XLA compilation is shape-keyed; values are irrelevant:
+    padded rows hold projective infinities and zero scalars exactly like
+    real padding). Shapes are scored and registered exactly like
+    dispatched batches: cold shapes count on
+    tpu_compile_cache_misses_total and land in the persistent registry
+    after the executable exists, warm ones count hits. Per-bucket wall
+    seconds are published on tpu_warm_compile_seconds (and returned) so
+    deploys can budget the pass.
 
     `runner` is injectable for tests: called as runner(kind, args) with
-    kind in {"staged", "aggregated"}; the default drives the real
-    executables and blocks until compile + run complete. Returns a list
-    of {"bucket", "seconds", "compiled"} dicts.
+    kind in {"staged", "aggregated", "mesh", "mesh-grouped"}; the
+    default drives the real executables and blocks until compile + run
+    complete. Returns a list of {"bucket", "seconds", "compiled"} dicts.
     """
     if buckets is None:
         buckets = DEFAULT_WARM_BUCKETS
     if runner is None:
         def runner(kind, args):
+            if kind.startswith("mesh"):
+                bool(_mesh_verifier().verify(args))
+                return
             if kind == "aggregated":
                 out = verify_device_aggregated(*args)
             else:
@@ -784,6 +820,7 @@ def warm_compile(buckets=None, runner=None):
     for n_b, k_b, m_b in buckets:
         aggregated = _msg_agg_enabled() and m_b < n_b
         g_b = grid_bucket(n_b) if aggregated else 0
+        mesh = _mesh_eligible(n_b)
         u = jnp.zeros((m_b, 2, 2, W), jnp.int32)
         pk = jnp.broadcast_to(
             jnp.asarray(_INF_G1), (n_b, k_b, 3, W)
@@ -793,7 +830,17 @@ def warm_compile(buckets=None, runner=None):
         real = jnp.zeros((n_b,), bool)
         new_key = _count_shape_bucket(n_b, k_b, m_b, g_b)
         t0 = time.monotonic()
-        if aggregated:
+        if mesh and aggregated:
+            member = jnp.zeros((n_b, m_b), bool)
+            msg_real = jnp.zeros((m_b,), bool)
+            runner(
+                "mesh-grouped",
+                (u, pk, sig, scalars, real, member, msg_real),
+            )
+        elif mesh:
+            u_set = jnp.zeros((n_b, 2, 2, W), jnp.int32)
+            runner("mesh", (u_set, pk, sig, scalars, real))
+        elif aggregated:
             grid_idx = jnp.zeros((m_b, g_b), jnp.int32)
             grid_real = jnp.zeros((m_b, g_b), bool)
             runner(
@@ -863,19 +910,47 @@ def aggregate_verify(signature, pubkeys, messages) -> bool:
 # --- device-resident pubkey table ------------------------------------------
 
 
+def _shard_table_enabled() -> bool:
+    """Mesh-sharding of the validator pubkey table is ON unless explicitly
+    disabled; read per call so tests/benches flip it without reimport."""
+    return os.environ.get("LIGHTHOUSE_TPU_SHARD_TABLE", "1") != "0"
+
+
 class PubkeyTable:
     """Decompressed validator pubkeys resident on device, keyed by validator
     index -- the TPU analogue of the reference's ValidatorPubkeyCache
     (beacon_node/beacon_chain/src/validator_pubkey_cache.rs:10-23,131).
     Upload once per import of new validators; per-batch traffic is indices.
+
+    Tables past a size floor shard their validator-index dimension over
+    the `validators` mesh axis (parallel/verify_sharded.validators_mesh):
+    each device holds a contiguous ~1/N slice of the bucketed rows instead
+    of a full replica, so registry growth costs per-device HBM that scales
+    DOWN with mesh size. Batches then pull exactly their indices through a
+    shard_map gather (each index is owned by exactly one shard; a masked
+    local take + psum lands the rows on every participating chip). Small
+    tables -- below one 8-row shard floor per device, e.g. the committee-
+    aggregate family -- stay replicated on the default device: a
+    collective per batch would cost more than the bytes saved.
+
+    `import_new_pubkeys` only invalidates: the next `device_table()` call
+    re-places the grown bucket across the mesh, which re-balances the
+    shards evenly (contiguous rows re-split N ways) rather than appending
+    to the last shard.
     """
 
     def __init__(self):
         self._host = np.zeros((0, 3, W), np.int32)
         self._dev = None
+        self._gather = None
 
     def __len__(self) -> int:
         return self._host.shape[0]
+
+    @property
+    def sharded(self) -> bool:
+        self.device_table()
+        return self._gather is not None
 
     def import_new_pubkeys(self, pubkeys) -> None:
         """Append validated pubkeys (mirrors import_new_pubkeys,
@@ -884,21 +959,55 @@ class PubkeyTable:
             return
         rows = np.stack([_pk_limbs(pk) for pk in pubkeys])
         self._host = np.concatenate([self._host, rows], axis=0)
-        self._dev = None  # re-upload lazily
+        self._dev = None  # re-place (and re-balance shards) lazily
+        self._gather = None
 
     def device_table(self):
         if self._dev is None:
+            from ....parallel.verify_sharded import (
+                VALIDATOR_AXIS,
+                make_sharded_gather,
+                pow2_device_prefix,
+                validators_mesh,
+            )
+
             n = len(self._host)
             b = _bucket(max(n, 1), floor=8)
             padded = np.broadcast_to(_INF_G1, (b, 3, W)).copy()
             padded[:n] = self._host
-            self._dev = jnp.asarray(padded)
-            metrics.TPU_PUBKEY_TABLE_BYTES.set(padded.nbytes)
+            devs = pow2_device_prefix()
+            n_dev = len(devs)
+            if _shard_table_enabled() and n_dev > 1 and b >= n_dev * 8:
+                from jax.sharding import NamedSharding, PartitionSpec
+
+                mesh = validators_mesh(devs)
+                self._dev = jax.device_put(
+                    padded, NamedSharding(mesh, PartitionSpec(VALIDATOR_AXIS))
+                )
+                self._gather = make_sharded_gather(mesh)
+                per_dev = padded.nbytes // n_dev
+                for d in devs:
+                    metrics.TPU_PUBKEY_TABLE_BYTES.set(str(d.id), per_dev)
+            else:
+                self._dev = jnp.asarray(padded)
+                self._gather = None
+                dev_id = next(iter(self._dev.devices())).id
+                metrics.TPU_PUBKEY_TABLE_BYTES.set(str(dev_id), padded.nbytes)
         return self._dev
 
     def gather(self, indices):
-        """(m,) validator indices -> (m, 3, W) device points."""
-        return jnp.take(self.device_table(), jnp.asarray(indices), axis=0)
+        """Validator indices (any shape) -> (..., 3, W) device points.
+        Out-of-range indices clip to the last bucketed row (marshalling
+        masks them to infinity anyway). Routes through the shard_map
+        gather when the table is mesh-sharded."""
+        table = self.device_table()
+        idx = jnp.asarray(indices, dtype=jnp.int32)
+        metrics.TPU_PUBKEY_GATHER_BATCHES.inc()
+        metrics.TPU_PUBKEY_GATHER_BYTES.inc(int(idx.size) * 3 * W * 4)
+        if self._gather is None:
+            return jnp.take(table, idx, axis=0, mode="clip")
+        rows = self._gather(table, idx.reshape((-1,)))
+        return rows.reshape(idx.shape + (3, W))
 
 
 # --- speculative verification: committee aggregate residency ----------------

@@ -20,14 +20,17 @@ observable result order identical to the synchronous path.
 
 Backends participate at two levels of the same module duck type:
 
-  * ``dispatch_verify_signature_sets(sets, seed=None, groups=None)``
-    (jax_tpu): does host marshalling + device enqueue, returns a
-    zero-dim device array (or a plain bool for structural early-exits).
-    True async. Backends that accept ``groups`` get the batch's
-    message-aggregation plan (``aggregation.MessageGroups``) computed by
-    the pipeline PRE-marshal on the submit thread, so the double buffer
-    overlaps batch N+1's grouping with batch N's device work -- the
-    mega-pairing's host half rides the same overlap as limb packing.
+  * ``dispatch_verify_signature_sets(sets, seed=None, groups=None,
+    index_pack=None)`` (jax_tpu): does host marshalling + device
+    enqueue, returns a zero-dim device array (or a plain bool for
+    structural early-exits). True async. Backends that accept ``groups``
+    get the batch's message-aggregation plan
+    (``aggregation.MessageGroups``) computed by the pipeline PRE-marshal
+    on the submit thread, so the double buffer overlaps batch N+1's
+    grouping with batch N's device work -- the mega-pairing's host half
+    rides the same overlap as limb packing. Backends that additionally
+    expose ``prepack_indices`` and accept ``index_pack`` get the gather
+    path's validator-index pack the same way.
   * ``verify_signature_sets`` only (cpu, fake, fallback): the pipeline
     degrades to compute-at-submit; futures still behave identically, so
     callers never branch on the backend.
@@ -144,14 +147,14 @@ class VerifyPipeline:
         return api._ensure_backend()
 
     @staticmethod
-    def _accepts_groups(dispatch) -> bool:
-        """True when the backend's dispatch hook takes the pre-computed
-        message-aggregation plan (the extended duck type; older stubs
-        keep working without it). Inspected per submit -- once per BATCH,
-        not per set -- rather than memoized: an id()-keyed memo would go
+    def _accepts(dispatch, name: str) -> bool:
+        """True when the backend's dispatch hook takes the named
+        pre-computed keyword (the extended duck type; older stubs keep
+        working without it). Inspected per submit -- once per BATCH, not
+        per set -- rather than memoized: an id()-keyed memo would go
         stale under bound-method id reuse."""
         try:
-            return "groups" in inspect.signature(dispatch).parameters
+            return name in inspect.signature(dispatch).parameters
         except (TypeError, ValueError):
             return False
 
@@ -173,14 +176,22 @@ class VerifyPipeline:
                 backend, "dispatch_verify_signature_sets", None
             )
             if dispatch is not None:
-                if self._accepts_groups(dispatch):
+                if self._accepts(dispatch, "groups"):
                     # pre-marshal aggregation on the SUBMIT thread: the
                     # grouping of batch N+1 overlaps batch N's device
                     # work exactly like limb packing does
                     with tracing.span("bls_aggregate", sets=len(sets)):
                         groups = aggregation.group_sets(sets)
                     self._record("pipeline_aggregate", fut.batch_id)
-                    fut._value = dispatch(sets, seed=seed, groups=groups)
+                    kwargs = {"groups": groups}
+                    prepack = getattr(backend, "prepack_indices", None)
+                    if prepack is not None and self._accepts(
+                        dispatch, "index_pack"
+                    ):
+                        # the gather path's validator-index pack also
+                        # rides the submit thread (same overlap)
+                        kwargs["index_pack"] = prepack(sets)
+                    fut._value = dispatch(sets, seed=seed, **kwargs)
                 else:
                     fut._value = dispatch(sets, seed=seed)
             else:

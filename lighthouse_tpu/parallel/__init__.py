@@ -10,6 +10,9 @@ from .verify_sharded import (  # noqa: F401
     DeviceProber,
     MeshEmpty,
     MeshVerifier,
+    make_sharded_gather,
     make_sharded_verify,
+    pow2_device_prefix,
     sets_mesh,
+    validators_mesh,
 )

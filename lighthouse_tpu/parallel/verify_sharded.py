@@ -44,11 +44,75 @@ from ..utils import metrics, tracing
 
 AXIS = "sets"
 
+# The VALIDATOR-STATE axis: the pubkey table and the epoch-transition
+# columns shard their validator-index dimension over this 1-D mesh
+# (ROADMAP million-validator item). It is a different *name* from the
+# batch `sets` axis -- batches shard by set, state shards by validator
+# index -- but rides the same physical devices as the MeshVerifier.
+VALIDATOR_AXIS = "validators"
+
 
 def sets_mesh(devices=None) -> Mesh:
     """1-D mesh over all (or the given) devices, axis name 'sets'."""
     devices = list(jax.devices()) if devices is None else list(devices)
     return Mesh(np.array(devices), (AXIS,))
+
+
+def pow2_device_prefix(devices=None) -> list:
+    """The largest power-of-two prefix of the device list: sharded state
+    divides bucketed (power-of-two) row counts evenly, so odd device
+    counts park the remainder devices rather than pad unevenly."""
+    devices = list(jax.devices()) if devices is None else list(devices)
+    size = 1
+    while size * 2 <= len(devices):
+        size *= 2
+    return devices[:size]
+
+
+def validators_mesh(devices=None) -> Mesh:
+    """1-D validator-state mesh over a power-of-two device prefix."""
+    return Mesh(np.array(pow2_device_prefix(devices)), (VALIDATOR_AXIS,))
+
+
+def make_sharded_gather(mesh: Mesh):
+    """Returns a jitted gather over `mesh`: the table (rows, 3, W) shards
+    its leading validator-index axis; the (m,) index vector replicates.
+    Each shard gathers the indices it OWNS (its contiguous row block)
+    and zero-fills the rest; one int32 psum of the (m, 3, W) gathered
+    rows -- every index is owned by exactly one shard -- completes the
+    batch's rows on every chip. Out-of-range indices clip to the last
+    row (the callers' `mode="clip"` contract; clipped rows are padding
+    and masked downstream).
+
+    The returned callable carries ``arg_specs`` so DeviceExecutor-style
+    placement helpers know the table shards while indices replicate."""
+
+    n_shards = int(mesh.devices.size)
+
+    def gather_fn(table_shard, idx):
+        rows = table_shard.shape[0]
+        idx = jnp.clip(idx, 0, rows * n_shards - 1)
+        off = jax.lax.axis_index(VALIDATOR_AXIS).astype(jnp.int32) * rows
+        local = idx - off
+        owned = (local >= 0) & (local < rows)
+        vals = jnp.take(table_shard, jnp.where(owned, local, 0), axis=0)
+        vals = jnp.where(owned[:, None, None], vals, 0)
+        return jax.lax.psum(vals, VALIDATOR_AXIS)
+
+    specs = (P(VALIDATOR_AXIS), P())
+    kw = dict(mesh=mesh, in_specs=specs, out_specs=P())
+    try:
+        body = shard_map(gather_fn, check_vma=False, **kw)
+    except TypeError:  # pre-0.6 jax spells the flag check_rep
+        body = shard_map(gather_fn, check_rep=False, **kw)
+    fn = jax.jit(body)
+
+    # a plain wrapper because jit objects reject attribute assignment
+    def call(table, idx):
+        return fn(table, idx)
+
+    call.arg_specs = specs
+    return call
 
 
 def make_sharded_verify(mesh: Mesh):

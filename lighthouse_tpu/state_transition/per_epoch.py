@@ -48,6 +48,14 @@ def process_epoch(state, preset: Preset, spec):
         return
     from .per_epoch_vec import VectorGuard, process_epoch_altair_vec
 
+    if os.environ.get("LIGHTHOUSE_TPU_EPOCH_MESH") == "1":
+        from .per_epoch_mesh import process_epoch_altair_mesh
+
+        try:
+            process_epoch_altair_mesh(state, preset, spec)
+            return
+        except VectorGuard:
+            pass  # fall through: vec, then (via its guard) the oracle
     try:
         process_epoch_altair_vec(state, preset, spec)
     except VectorGuard:

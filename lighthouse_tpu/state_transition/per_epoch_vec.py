@@ -42,9 +42,12 @@ class _Columns:
     """One pass over the registry extracting the epoch-processing columns.
 
     Cached on the state instance keyed by the validators tuple's identity
-    (`state.__dict__['_lh_epoch_cols']`): epoch N+1 reuses epoch N's
-    arrays — which the epoch-N writeback kept in sync — unless block
-    processing replaced the registry tuple in between. clone_state is an
+    AND the preset (`state.__dict__['_lh_epoch_cols']`): epoch N+1 reuses
+    epoch N's arrays — which the epoch-N writeback kept in sync — unless
+    block processing replaced the registry tuple in between. The preset
+    key matters when a harness swaps presets mid-process on a reused
+    state object: identical tuple identity under a different preset must
+    re-extract rather than serve stale column widths. clone_state is an
     SSZ round trip (fresh __dict__), so clones never alias the cache."""
 
     def __init__(self, state):
@@ -77,10 +80,15 @@ class _Columns:
         return (self.activation <= e) & (e < self.exit)
 
 
-def _columns_for(state) -> _Columns:
+def _columns_for(state, preset) -> _Columns:
     cached = state.__dict__.get("_lh_epoch_cols")
-    if cached is not None and cached[0] is state.validators:
-        return cached[1]
+    if (
+        cached is not None
+        and len(cached) == 3
+        and cached[0] is state.validators
+        and cached[1] is preset
+    ):
+        return cached[2]
     return _Columns(state)
 
 
@@ -115,7 +123,7 @@ def process_epoch_altair_vec(state, preset: Preset, spec) -> None:
     current_epoch = _current_epoch(state, preset)
     previous_epoch = _previous_epoch(state, preset)
     original_validators = state.validators
-    cols = _columns_for(state)
+    cols = _columns_for(state, preset)
     n = cols.n
     incr = spec.effective_balance_increment
 
@@ -274,7 +282,7 @@ def process_epoch_altair_vec(state, preset: Preset, spec) -> None:
         surgical_list_update(
             state, "validators", original_validators, final, sorted(changed)
         )
-    state.__dict__["_lh_epoch_cols"] = (state.validators, cols)
+    state.__dict__["_lh_epoch_cols"] = (state.validators, preset, cols)
 
     # 8-10. resets, historical roots, rotation, sync committees
     _process_slashings_reset(state, preset)

@@ -511,9 +511,22 @@ TPU_MARSHAL_BATCH_BYTES = REGISTRY.gauge(
     "tpu_marshal_batch_bytes",
     "Host-to-device bytes of the most recent marshalled batch",
 )
-TPU_PUBKEY_TABLE_BYTES = REGISTRY.gauge(
+TPU_PUBKEY_TABLE_BYTES = REGISTRY.labeled_gauge(
     "tpu_pubkey_table_bytes",
-    "Device-resident decompressed pubkey table size in bytes",
+    "Decompressed pubkey-table bytes RESIDENT PER DEVICE (label: device "
+    "id). Replicated tables repeat the full size on every device; the "
+    "mesh-sharded table holds ~1/N of the bucketed rows per device",
+    label="device",
+)
+TPU_PUBKEY_GATHER_BYTES = REGISTRY.counter(
+    "tpu_pubkey_gather_bytes_total",
+    "Pubkey limb-row bytes pulled to the verifying chip by per-batch "
+    "gathers from the (sharded) device-resident table",
+)
+TPU_PUBKEY_GATHER_BATCHES = REGISTRY.counter(
+    "tpu_pubkey_gather_batches_total",
+    "Verification batches whose pubkeys were gathered from the "
+    "device-resident table by validator index",
 )
 MESH_CHIP_BATCH_SECONDS = REGISTRY.labeled_gauge(
     "bls_mesh_chip_last_batch_seconds",

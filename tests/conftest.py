@@ -101,6 +101,7 @@ def pytest_collection_modifyitems(session, config, items):
     after."""
     compile_heavy = (
         "test_multichip",  # biggest programs: keep the freshest slot
+        "test_sharded_state",  # shard_map gather + mesh epoch programs
         "test_tpu_",
         "test_pallas_kernels",
         "test_bls_api",

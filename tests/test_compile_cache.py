@@ -125,7 +125,17 @@ class TestTelemetrySurfacing:
             for (n_b, k_b, m_b), (kind, shapes) in zip(
                 jax_tpu.DEFAULT_WARM_BUCKETS, calls
             ):
-                if m_b < n_b:  # message aggregation collapses the bucket
+                mesh = jax_tpu._mesh_eligible(n_b)
+                if m_b < n_b and mesh:
+                    # shard-threshold bucket on the multi-device test
+                    # mesh: the grouped mesh body, membership mask
+                    # sharded with the sets axis
+                    assert kind == "mesh-grouped"
+                    assert shapes[-2:] == ((n_b, m_b), (m_b,))
+                elif mesh:
+                    assert kind == "mesh"
+                    assert shapes[0][0] == n_b  # per-set draws, expanded
+                elif m_b < n_b:  # message aggregation collapses the bucket
                     assert kind == "aggregated"
                     # the grid's group axis is PINNED to n_b: the warmed
                     # shape is exactly what _marshal_batch produces

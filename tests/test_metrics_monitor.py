@@ -283,6 +283,49 @@ class TestSlotDelayAndDeviceTelemetry:
         assert TPU_COMPILE_CACHE_HITS.value == hits + 1
         assert TPU_COMPILE_CACHE_MISSES.value == misses + 1
 
+    def test_pubkey_table_gauge_is_per_device_and_gathers_count(self):
+        """tpu_pubkey_table_bytes is labeled by device: a mesh-sharded
+        table reports ~1/N of the bucketed bytes on EACH device (the HBM
+        scaling claim of the sharded registry), and every gather counts
+        a batch plus the limb-row bytes it pulled to the verifying chip.
+        """
+        import numpy as np
+
+        from lighthouse_tpu.crypto.bls.backends import jax_tpu
+        from lighthouse_tpu.utils.metrics import (
+            TPU_PUBKEY_GATHER_BATCHES,
+            TPU_PUBKEY_GATHER_BYTES,
+            TPU_PUBKEY_TABLE_BYTES,
+        )
+
+        rng = np.random.default_rng(3)
+        table = jax_tpu.PubkeyTable()
+        n = 100  # buckets to 128 rows: >= 8 per device on the test mesh
+        table._host = rng.integers(
+            0, 2**28, size=(n, 3, jax_tpu.W)
+        ).astype(np.int32)
+        dev = table.device_table()
+        n_dev = len(dev.sharding.mesh.devices) if table.sharded else 1
+        assert table.sharded == (n_dev > 1)
+        total = 128 * 3 * jax_tpu.W * 4
+        for d in dev.sharding.mesh.devices.flat if table.sharded else []:
+            assert TPU_PUBKEY_TABLE_BYTES.get(str(d.id)) == total // n_dev
+        assert (
+            'tpu_pubkey_table_bytes{device="0"}' in REGISTRY.expose()
+        )
+
+        batches = TPU_PUBKEY_GATHER_BATCHES.value
+        gathered = TPU_PUBKEY_GATHER_BYTES.value
+        idx = np.array([[0, 5], [99, 1]], dtype=np.int32)
+        rows = np.asarray(table.gather(idx))
+        assert rows.shape == (2, 2, 3, jax_tpu.W)
+        assert np.array_equal(rows[0, 0], table._host[0])
+        assert TPU_PUBKEY_GATHER_BATCHES.value == batches + 1
+        assert (
+            TPU_PUBKEY_GATHER_BYTES.value
+            == gathered + idx.size * 3 * jax_tpu.W * 4
+        )
+
 
 class TestChainMetricsAndMonitor:
     def test_block_import_populates_phase_timers_and_monitor(self):

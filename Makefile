@@ -2,18 +2,24 @@
 
 PY ?= python
 
-.PHONY: lint lint-baseline test test-lint test-chaos test-crash \
-	test-scenario test-serving test-speculate test-kernels \
+.PHONY: lint lint-changed lint-baseline test test-lint test-chaos \
+	test-crash test-scenario test-serving test-speculate test-kernels \
 	test-fuzz fuzz bench-serving bench-speculate bench-scale \
 	test-sharded warm-compile
 
-## lint: AST consensus-safety & TPU-hazard pass (tools/lint, stdlib-only)
+## lint: per-file + interprocedural project pass (tools/lint, stdlib-only);
+## times itself and fails over the 10s budget so it never becomes a
+## pre-commit tax
 lint:
-	$(PY) -m tools.lint
+	$(PY) -m tools.lint --project --budget-s 10
+
+## lint-changed: pre-commit fast path -- only files git says changed
+lint-changed:
+	$(PY) -m tools.lint --project --changed-only --budget-s 10
 
 ## lint-baseline: regenerate the ratchet file after burning down debt
 lint-baseline:
-	$(PY) -m tools.lint --write-baseline
+	$(PY) -m tools.lint --project --write-baseline
 
 ## test: tier-1 suite (CPU, excludes slow/TPU-only)
 test:

@@ -10,7 +10,7 @@ from __future__ import annotations
 
 from dataclasses import dataclass, field
 
-from ..utils.metrics import REGISTRY
+from ..utils import metrics as M
 
 
 @dataclass
@@ -81,35 +81,15 @@ class ValidatorMonitor:
         self.block_times: dict[bytes, BlockTimes] = {}
         self._last_evaluated_epoch: int | None = None
         self._retired_through: int | None = None
-        self._proposals = REGISTRY.counter(
-            "validator_monitor_blocks_proposed_total",
-            "Blocks proposed by monitored validators",
-        )
-        self._attestations = REGISTRY.counter(
-            "validator_monitor_attestations_total",
-            "Attestations by monitored validators seen on-chain or gossip",
-        )
-        self._inclusion_delay = REGISTRY.histogram(
-            "validator_monitor_attestation_inclusion_delay_slots",
-            "Slots between attestation slot and block inclusion",
-            buckets=(1, 2, 3, 4, 8, 16, 32),
-        )
-        self._target_misses = REGISTRY.counter(
-            "validator_monitor_prev_epoch_target_misses_total",
-            "Monitored validators that missed the target in an epoch",
-        )
-        self._head_misses = REGISTRY.counter(
-            "validator_monitor_prev_epoch_head_misses_total",
-            "Monitored validators that missed the head in an epoch",
-        )
-        self._sync_signatures = REGISTRY.counter(
-            "validator_monitor_sync_committee_messages_total",
-            "Sync-committee messages by monitored validators",
-        )
-        self._slashed = REGISTRY.counter(
-            "validator_monitor_slashings_total",
-            "Slashings naming monitored validators",
-        )
+        # families are declared in utils/metrics.py (metric-origin rule:
+        # the /metrics surface is enumerable from that one module)
+        self._proposals = M.VALIDATOR_MONITOR_PROPOSALS
+        self._attestations = M.VALIDATOR_MONITOR_ATTESTATIONS
+        self._inclusion_delay = M.VALIDATOR_MONITOR_INCLUSION_DELAY
+        self._target_misses = M.VALIDATOR_MONITOR_TARGET_MISSES
+        self._head_misses = M.VALIDATOR_MONITOR_HEAD_MISSES
+        self._sync_signatures = M.VALIDATOR_MONITOR_SYNC_SIGNATURES
+        self._slashed = M.VALIDATOR_MONITOR_SLASHED
 
     def register_validator(self, index: int) -> None:
         self.validators.setdefault(index, MonitoredValidator(index))

@@ -33,6 +33,9 @@ def _build_lib() -> str:
             _LIB_PATH
         ) >= os.path.getmtime(_SRC):
             return _LIB_PATH
+        # lint: allow[blocking-under-lock] -- the build lock exists to
+        # serialize exactly this one-time g++ compile; every later call
+        # takes the mtime fast path above
         subprocess.run(
             [
                 "g++", "-O2", "-shared", "-fPIC", "-std=c++17",

@@ -10,7 +10,7 @@ import threading
 import traceback
 from dataclasses import dataclass, field
 
-from .metrics import REGISTRY
+from . import metrics
 
 
 @dataclass
@@ -26,12 +26,9 @@ class TaskExecutor:
         self._shutdown = threading.Event()
         self._reason: ShutdownReason | None = None
         self._lock = threading.Lock()
-        self._tasks_total = REGISTRY.counter(
-            "executor_tasks_spawned_total", "Tasks spawned via TaskExecutor"
-        )
-        self._panics = REGISTRY.counter(
-            "executor_task_panics_total", "Tasks that died with an exception"
-        )
+        # families live in utils/metrics.py (metric-origin rule)
+        self._tasks_total = metrics.EXECUTOR_TASKS_SPAWNED
+        self._panics = metrics.EXECUTOR_TASK_PANICS
 
     # -- spawn (task_executor spawn / spawn_blocking) -----------------------
 

@@ -650,3 +650,46 @@ SPECULATE_TABLE_BYTES = REGISTRY.gauge(
     "Device-resident per-committee aggregate-pubkey table size in bytes "
     "(lives next to the validator pubkey table in the jax_tpu backend)",
 )
+
+# -- the validator-monitor metric family (validator_monitor.rs) ---------------
+# Families live HERE (metric-origin lint rule): the monitor references
+# them, so the /metrics surface stays enumerable from this one module.
+
+VALIDATOR_MONITOR_PROPOSALS = REGISTRY.counter(
+    "validator_monitor_blocks_proposed_total",
+    "Blocks proposed by monitored validators",
+)
+VALIDATOR_MONITOR_ATTESTATIONS = REGISTRY.counter(
+    "validator_monitor_attestations_total",
+    "Attestations by monitored validators seen on-chain or gossip",
+)
+VALIDATOR_MONITOR_INCLUSION_DELAY = REGISTRY.histogram(
+    "validator_monitor_attestation_inclusion_delay_slots",
+    "Slots between attestation slot and block inclusion",
+    buckets=(1, 2, 3, 4, 8, 16, 32),
+)
+VALIDATOR_MONITOR_TARGET_MISSES = REGISTRY.counter(
+    "validator_monitor_prev_epoch_target_misses_total",
+    "Monitored validators that missed the target in an epoch",
+)
+VALIDATOR_MONITOR_HEAD_MISSES = REGISTRY.counter(
+    "validator_monitor_prev_epoch_head_misses_total",
+    "Monitored validators that missed the head in an epoch",
+)
+VALIDATOR_MONITOR_SYNC_SIGNATURES = REGISTRY.counter(
+    "validator_monitor_sync_committee_messages_total",
+    "Sync-committee messages by monitored validators",
+)
+VALIDATOR_MONITOR_SLASHED = REGISTRY.counter(
+    "validator_monitor_slashings_total",
+    "Slashings naming monitored validators",
+)
+
+# -- the task-executor metric family (task_executor/src/metrics.rs) -----------
+
+EXECUTOR_TASKS_SPAWNED = REGISTRY.counter(
+    "executor_tasks_spawned_total", "Tasks spawned via TaskExecutor"
+)
+EXECUTOR_TASK_PANICS = REGISTRY.counter(
+    "executor_task_panics_total", "Tasks that died with an exception"
+)

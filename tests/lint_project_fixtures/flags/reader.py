@@ -1,0 +1,6 @@
+"""Planted env-flag drift: one unregistered read, one clean read."""
+
+import os
+
+UNREGISTERED = os.environ.get("LIGHTHOUSE_TPU_PLANTED_UNREGISTERED")
+OK = os.environ.get("LIGHTHOUSE_TPU_PLANTED_OK", "1")

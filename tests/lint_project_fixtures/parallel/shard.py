@@ -1,0 +1,9 @@
+"""Planted mesh-axis mismatch: one good spec, one typo'd spec."""
+
+import numpy as np
+from jax.sharding import Mesh, PartitionSpec as P
+
+MESH = Mesh(np.array([0]), ("rows",))
+
+GOOD_SPEC = P("rows")
+BAD_SPEC = P("colums")

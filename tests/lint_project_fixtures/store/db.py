@@ -1,0 +1,17 @@
+"""Planted lock-order cycle, half one: DB lock -> journal lock."""
+
+import threading
+
+from store import journal
+
+_DB_LOCK = threading.Lock()
+
+
+def write(row):
+    with _DB_LOCK:
+        journal.append_row(row)
+
+
+def checkpoint():
+    with _DB_LOCK:
+        return True

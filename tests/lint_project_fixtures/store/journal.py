@@ -1,0 +1,17 @@
+"""Planted lock-order cycle, half two: journal lock -> DB lock."""
+
+import threading
+
+from store import db
+
+_JOURNAL_LOCK = threading.Lock()
+
+
+def append_row(row):
+    with _JOURNAL_LOCK:
+        return row
+
+
+def flush():
+    with _JOURNAL_LOCK:
+        db.checkpoint()

@@ -19,9 +19,27 @@ from tools.lint.engine import (
     lint_paths,
     load_baseline,
 )
+from tools.lint.project import (
+    PROJECT_RULES,
+    PROJECT_RULES_BY_ID,
+    LockOrderRule,
+    lint_project,
+)
 from tools.lint.rules import ALL_RULES, RULES_BY_ID
 
 REPO_ROOT = Path(__file__).resolve().parents[1]
+FIXTURE_TREE = Path(__file__).resolve().parent / "lint_project_fixtures"
+
+
+def project_fixture(tmp_path, files, rules=None):
+    """Write a multi-file tree and run the project rules over it."""
+    for rel, src in files.items():
+        p = tmp_path / rel
+        p.parent.mkdir(parents=True, exist_ok=True)
+        p.write_text(textwrap.dedent(src))
+    violations, errors = lint_project(tmp_path, rules=rules)
+    assert not errors, errors
+    return violations
 
 
 def lint_fixture(tmp_path, relpath, source):
@@ -1032,10 +1050,18 @@ def test_baseline_empty_means_any_violation_is_new():
 def test_rule_catalogue_complete():
     """Every rule has an id, a docstring, and appears in the registry."""
     assert len(ALL_RULES) == 13
+    assert len(PROJECT_RULES) == 6
+    assert len(ALL_RULES) + len(PROJECT_RULES) == 19
     for rule in ALL_RULES:
         assert rule.id and rule.id == rule.id.lower()
         assert rule.__doc__ and rule.id in rule.__doc__.split(":")[0]
         assert RULES_BY_ID[rule.id] is rule
+    for rule in PROJECT_RULES:
+        assert rule.id and rule.id == rule.id.lower()
+        assert rule.__doc__ and rule.id in rule.__doc__.split(":")[0]
+        assert PROJECT_RULES_BY_ID[rule.id] is rule
+    # the two catalogues never collide on an id
+    assert not set(RULES_BY_ID) & set(PROJECT_RULES_BY_ID)
 
 
 def test_repo_is_lint_clean_against_baseline():
@@ -1167,7 +1193,9 @@ def test_write_baseline_subset_preserves_out_of_scope_entries(tmp_path):
     assert main(args + ["."]) == 0
 
 
-@pytest.mark.parametrize("rule", [r.id for r in ALL_RULES])
+@pytest.mark.parametrize(
+    "rule", [r.id for r in ALL_RULES] + [r.id for r in PROJECT_RULES]
+)
 def test_every_rule_has_fixture_coverage(rule):
     """Meta-test: this file contains a positive and negative fixture (or
     dedicated test) for every registered rule id."""
@@ -1175,3 +1203,713 @@ def test_every_rule_has_fixture_coverage(rule):
     token = rule.replace("-", "_")
     assert f"def test_{token}_positive" in source or f'"{rule}"' in source
     assert f"def test_{token}_negative" in source or f'"{rule}"' in source
+
+
+# --- project rules: lock-order ----------------------------------------------
+
+
+def test_lock_order_positive_cross_module_cycle(tmp_path):
+    """The multi-module witness-chain case: a 2-lock cycle split across
+    two modules, each edge created through a cross-module call."""
+    vs = project_fixture(tmp_path, {
+        "store/db.py": """
+            import threading
+            from store import journal
+            _DB_LOCK = threading.Lock()
+            def write(row):
+                with _DB_LOCK:
+                    journal.append_row(row)
+            def checkpoint():
+                with _DB_LOCK:
+                    return True
+        """,
+        "store/journal.py": """
+            import threading
+            from store import db
+            _JOURNAL_LOCK = threading.Lock()
+            def append_row(row):
+                with _JOURNAL_LOCK:
+                    return row
+            def flush():
+                with _JOURNAL_LOCK:
+                    db.checkpoint()
+        """,
+    }, rules=[LockOrderRule()])
+    assert rules_hit(vs) == {"lock-order"}
+    [v] = vs
+    assert "cycle" in v.message
+    # the witness chain must cross the module boundary
+    assert "store/db.py::write" in v.message
+    assert "store/journal.py::append_row" in v.message
+
+
+def test_lock_order_negative_consistent_order(tmp_path):
+    """Same two locks, but every path agrees on the order: clean."""
+    vs = project_fixture(tmp_path, {
+        "store/db.py": """
+            import threading
+            from store import journal
+            _DB_LOCK = threading.Lock()
+            def write(row):
+                with _DB_LOCK:
+                    journal.append_row(row)
+        """,
+        "store/journal.py": """
+            import threading
+            _JOURNAL_LOCK = threading.Lock()
+            def append_row(row):
+                with _JOURNAL_LOCK:
+                    return row
+        """,
+    }, rules=[LockOrderRule()])
+    assert vs == []
+
+
+def test_lock_order_positive_self_deadlock_plain_lock(tmp_path):
+    vs = project_fixture(tmp_path, {
+        "svc/worker.py": """
+            import threading
+            class Svc:
+                def __init__(self):
+                    self._lock = threading.Lock()
+                def outer(self):
+                    with self._lock:
+                        self.inner()
+                def inner(self):
+                    with self._lock:
+                        return 1
+        """,
+    }, rules=[LockOrderRule()])
+    assert rules_hit(vs) == {"lock-order"}
+    assert "single-thread deadlock" in vs[0].message
+
+
+def test_lock_order_negative_rlock_reentry(tmp_path):
+    """RLock (and *RLock wrappers) may legally re-enter themselves."""
+    for ctor in ("threading.RLock()", "TimeoutRLock('x')"):
+        vs = project_fixture(tmp_path, {
+            "svc/worker.py": f"""
+                import threading
+                class TimeoutRLock:
+                    def __init__(self, name):
+                        self.name = name
+                class Svc:
+                    def __init__(self):
+                        self._lock = {ctor}
+                    def outer(self):
+                        with self._lock:
+                            self.inner()
+                    def inner(self):
+                        with self._lock:
+                            return 1
+            """,
+        }, rules=[LockOrderRule()])
+        assert vs == [], (ctor, vs)
+
+
+def test_lock_order_positive_table_inversion(tmp_path):
+    """Acquiring a table-OUTER lock while holding a table-INNER one
+    fails even without a full cycle; also exercises the distinctive
+    method-name fallback (`self.helper.grab()`)."""
+    vs = project_fixture(tmp_path, {
+        "m/outerlock.py": """
+            import threading
+            class Outer:
+                def __init__(self):
+                    self.big_lock = threading.Lock()
+                def grab_big(self):
+                    with self.big_lock:
+                        return 1
+        """,
+        "m/innerlock.py": """
+            import threading
+            from m.outerlock import Outer
+            class Inner:
+                def __init__(self):
+                    self.small_lock = threading.Lock()
+                    self.helper = Outer()
+                def bad(self):
+                    with self.small_lock:
+                        self.helper.grab_big()
+        """,
+    }, rules=[LockOrderRule(order=("Outer.big_lock", "Inner.small_lock"))])
+    assert rules_hit(vs) == {"lock-order"}
+    assert "inversion" in vs[0].message
+    assert "Outer.big_lock" in vs[0].message
+
+
+# --- project rules: blocking-under-lock -------------------------------------
+
+
+def test_blocking_under_lock_positive_direct(tmp_path):
+    vs = project_fixture(tmp_path, {
+        "svc/cache.py": """
+            import threading
+            import time
+            _L = threading.Lock()
+            def refresh():
+                with _L:
+                    time.sleep(0.1)
+        """,
+    }, rules=[PROJECT_RULES_BY_ID["blocking-under-lock"]])
+    assert rules_hit(vs) == {"blocking-under-lock"}
+    assert "time.sleep" in vs[0].message
+
+
+def test_blocking_under_lock_positive_transitive_with_witness(tmp_path):
+    """fsync two calls deep while the lock is held; the violation names
+    the full chain."""
+    vs = project_fixture(tmp_path, {
+        "store/disk.py": """
+            import os
+            import threading
+            _L = threading.Lock()
+            def commit(fd):
+                with _L:
+                    _persist(fd)
+            def _persist(fd):
+                _really_persist(fd)
+            def _really_persist(fd):
+                os.fsync(fd)
+        """,
+    }, rules=[PROJECT_RULES_BY_ID["blocking-under-lock"]])
+    assert rules_hit(vs) == {"blocking-under-lock"}
+    assert "witness" in vs[0].message
+    assert "_really_persist" in vs[0].message
+
+
+def test_blocking_under_lock_negative(tmp_path):
+    """Blocking outside the lock, and Condition.wait under it, are fine."""
+    vs = project_fixture(tmp_path, {
+        "svc/cache.py": """
+            import threading
+            import time
+            class C:
+                def __init__(self):
+                    self._lock = threading.Lock()
+                    self._cond = threading.Condition(self._lock)
+                def refresh(self):
+                    time.sleep(0.1)
+                    with self._lock:
+                        x = 1
+                    return x
+                def waiter(self):
+                    with self._cond:
+                        self._cond.wait()
+        """,
+    }, rules=[PROJECT_RULES_BY_ID["blocking-under-lock"]])
+    assert vs == []
+
+
+def test_blocking_under_lock_suppressible(tmp_path):
+    """A reasoned allow-comment at the blocking call site wins."""
+    vs = project_fixture(tmp_path, {
+        "store/disk.py": """
+            import os
+            import threading
+            _L = threading.Lock()
+            def commit(fd):
+                with _L:
+                    # lint: allow[blocking-under-lock] -- durability IS
+                    # the point of this lock
+                    os.fsync(fd)
+        """,
+    }, rules=[PROJECT_RULES_BY_ID["blocking-under-lock"]])
+    assert vs == []
+
+
+# --- project rules: env-flag-drift ------------------------------------------
+
+
+def test_env_flag_drift_positive_unregistered_read(tmp_path):
+    vs = project_fixture(tmp_path, {
+        "util/mode.py": """
+            import os
+            MODE = os.environ.get("LIGHTHOUSE_TPU_FAKE_MODE")
+        """,
+    }, rules=[PROJECT_RULES_BY_ID["env-flag-drift"]])
+    assert rules_hit(vs) == {"env-flag-drift"}
+    assert "LIGHTHOUSE_TPU_FAKE_MODE" in vs[0].message
+
+
+def test_env_flag_drift_positive_stale_entry_and_missing_anchor(tmp_path):
+    flags = {
+        "flags": {
+            "LIGHTHOUSE_TPU_GONE": {
+                "description": "no readers remain", "doc": "### Flags",
+            },
+            "LIGHTHOUSE_TPU_LIVE": {
+                "description": "read but undocumented", "doc": "### Flags",
+            },
+        }
+    }
+    vs = project_fixture(tmp_path, {
+        "util/mode.py": """
+            import os
+            LIVE = os.environ["LIGHTHOUSE_TPU_LIVE"]
+        """,
+        "tools/lint/flags.json": json.dumps(flags, indent=2),
+        # README documents neither flag nor anchor
+        "README.md": "# fixture\n",
+    }, rules=[PROJECT_RULES_BY_ID["env-flag-drift"]])
+    msgs = "\n".join(v.message for v in vs)
+    assert "stale flag registry entry LIGHTHOUSE_TPU_GONE" in msgs
+    assert "LIGHTHOUSE_TPU_LIVE" in msgs and "README.md" in msgs
+    # registry-side findings anchor in the registry file itself
+    assert any(v.path == "tools/lint/flags.json" for v in vs)
+
+
+def test_env_flag_drift_negative_registered_and_documented(tmp_path):
+    flags = {
+        "flags": {
+            "LIGHTHOUSE_TPU_GOOD": {
+                "description": "fully consistent", "doc": "### Flags",
+            },
+        }
+    }
+    vs = project_fixture(tmp_path, {
+        "util/mode.py": """
+            import os
+            GOOD = os.getenv("LIGHTHOUSE_TPU_GOOD", "1")
+        """,
+        "tools/lint/flags.json": json.dumps(flags, indent=2),
+        "README.md": "# fixture\n\n### Flags\n\nLIGHTHOUSE_TPU_GOOD\n",
+    }, rules=[PROJECT_RULES_BY_ID["env-flag-drift"]])
+    assert vs == []
+
+
+# --- project rules: mesh-axis -----------------------------------------------
+
+
+def test_mesh_axis_positive_typo_in_spec_and_collective(tmp_path):
+    vs = project_fixture(tmp_path, {
+        "parallel/shard.py": """
+            import numpy as np
+            from jax.sharding import Mesh, PartitionSpec as P
+            import jax
+            MESH = Mesh(np.array([0]), ("rows",))
+            BAD_SPEC = P("colums")
+            def reduce(x):
+                return jax.lax.psum(x, "rws")
+        """,
+    }, rules=[PROJECT_RULES_BY_ID["mesh-axis"]])
+    assert rules_hit(vs) == {"mesh-axis"}
+    msgs = "\n".join(v.message for v in vs)
+    assert "'colums'" in msgs and "'rws'" in msgs
+
+
+def test_mesh_axis_negative_declared_axes(tmp_path):
+    """Mesh-declared axes, the authoritative table, constants resolved
+    through module-level names, and dynamic names are all clean."""
+    vs = project_fixture(tmp_path, {
+        "parallel/shard.py": """
+            import numpy as np
+            from jax.sharding import Mesh, PartitionSpec as P
+            import jax
+            AXIS = "rows"
+            MESH = Mesh(np.array([0]), (AXIS,))
+            SPEC = P(AXIS)
+            AUTHORITATIVE = P("validators")
+            def reduce(x, axis):
+                return jax.lax.psum(x, axis)  # dynamic: skipped
+            def gather(x):
+                return jax.lax.all_gather(x, "sets", axis_name="rows")
+        """,
+    }, rules=[PROJECT_RULES_BY_ID["mesh-axis"]])
+    assert vs == []
+
+
+# --- project rules: metric-origin -------------------------------------------
+
+
+def test_metric_origin_positive_factory_outside_metrics(tmp_path):
+    vs = project_fixture(tmp_path, {
+        "utils/metrics.py": """
+            class Counter:
+                pass
+            class Registry:
+                def counter(self, name, doc):
+                    return Counter()
+            REGISTRY = Registry()
+        """,
+        "svc/worker.py": """
+            from utils.metrics import REGISTRY
+            class Worker:
+                def __init__(self):
+                    self.jobs = REGISTRY.counter("jobs_total", "jobs")
+        """,
+    }, rules=[PROJECT_RULES_BY_ID["metric-origin"]])
+    assert rules_hit(vs) == {"metric-origin"}
+    assert "utils/metrics.py" in vs[0].message
+
+
+def test_metric_origin_positive_module_level_construction(tmp_path):
+    vs = project_fixture(tmp_path, {
+        "utils/metrics.py": """
+            class Gauge:
+                pass
+        """,
+        "svc/worker.py": """
+            from utils.metrics import Gauge
+            DEPTH = Gauge()
+        """,
+    }, rules=[PROJECT_RULES_BY_ID["metric-origin"]])
+    assert rules_hit(vs) == {"metric-origin"}
+    assert "module-level" in vs[0].message
+
+
+def test_metric_origin_negative_rooted_in_metrics(tmp_path):
+    """A helper whose only caller is metrics.py module code is
+    sanctioned; referencing an already-constructed family is too."""
+    vs = project_fixture(tmp_path, {
+        "utils/metrics.py": """
+            class Counter:
+                def inc(self):
+                    pass
+            class Registry:
+                def counter(self, name, doc):
+                    return Counter()
+            REGISTRY = Registry()
+            def make_family(name):
+                return REGISTRY.counter(name, "doc")
+            JOBS = make_family("jobs_total")
+        """,
+        "svc/worker.py": """
+            from utils.metrics import JOBS
+            def run():
+                JOBS.inc()
+        """,
+    }, rules=[PROJECT_RULES_BY_ID["metric-origin"]])
+    assert vs == []
+
+
+# --- project rules: wallclock-taint -----------------------------------------
+
+
+def test_wallclock_taint_positive_cross_module_wrapper(tmp_path):
+    vs = project_fixture(tmp_path, {
+        "utils/helpers.py": """
+            import time
+            def current_seconds():
+                # lint: allow[wallclock] -- injection boundary
+                return time.time()
+        """,
+        "chain/fc.py": """
+            from utils.helpers import current_seconds
+            def on_block():
+                return current_seconds()
+        """,
+    }, rules=[PROJECT_RULES_BY_ID["wallclock-taint"]])
+    assert rules_hit(vs) == {"wallclock-taint"}
+    [v] = vs
+    assert v.path == "chain/fc.py"
+    assert "current_seconds" in v.message and "time.time" in v.message
+
+
+def test_wallclock_taint_negative_injected_clock_and_non_sink(tmp_path):
+    """Injected clock method calls never match (unknown receiver), and
+    wrapper calls from NON-consensus code are the per-file rule's
+    business, not this rule's."""
+    vs = project_fixture(tmp_path, {
+        "utils/helpers.py": """
+            import time
+            def current_seconds():
+                # lint: allow[wallclock] -- injection boundary
+                return time.time()
+        """,
+        "chain/fc.py": """
+            class ForkChoice:
+                def __init__(self, slot_clock):
+                    self.slot_clock = slot_clock
+                def on_block(self):
+                    return self.slot_clock.now()
+        """,
+        "serving/server.py": """
+            from utils.helpers import current_seconds
+            def uptime():
+                return current_seconds()
+        """,
+    }, rules=[PROJECT_RULES_BY_ID["wallclock-taint"]])
+    assert vs == []
+
+
+# --- the planted fixture tree -----------------------------------------------
+
+
+def test_planted_fixture_tree_fires_exactly_as_designed():
+    violations, errors = lint_project(FIXTURE_TREE)
+    assert not errors, errors
+    by_rule = {}
+    for v in violations:
+        by_rule.setdefault(v.rule, []).append(v)
+    assert set(by_rule) == {"lock-order", "env-flag-drift", "mesh-axis"}
+    [cycle] = by_rule["lock-order"]
+    assert "store/db.py::write" in cycle.message
+    assert "store/journal.py::append_row" in cycle.message
+    drift = {v.path for v in by_rule["env-flag-drift"]}
+    assert drift == {"flags/reader.py", "tools/lint/flags.json"}
+    # the consistent control flag must NOT fire
+    assert not any(
+        "PLANTED_OK" in v.message for v in by_rule["env-flag-drift"]
+    )
+    [axis] = by_rule["mesh-axis"]
+    assert "'colums'" in axis.message
+
+
+def test_project_reports_are_deterministic():
+    """Two runs produce byte-identical reports (text and SARIF)."""
+    from tools.lint.sarif import to_sarif
+
+    def run():
+        vs, errors = lint_project(FIXTURE_TREE)
+        assert not errors
+        text = "\n".join(str(v) for v in vs)
+        sarif = json.dumps(
+            to_sarif(vs, list(ALL_RULES) + list(PROJECT_RULES)),
+            indent=2, sort_keys=True,
+        )
+        return text, sarif
+
+    assert run() == run()
+
+
+def test_repo_is_project_lint_clean():
+    """The CI gate, project half: the interprocedural rules are clean
+    over the real tree (suppressions and fixes, no baseline debt)."""
+    violations, errors = lint_project(REPO_ROOT, ["lighthouse_tpu", "tools"])
+    assert not errors, errors
+    assert not violations, (
+        "project-lint violations:\n" + "\n".join(map(str, violations))
+    )
+
+
+def test_repo_project_run_is_deterministic():
+    """Two full-repo project passes produce byte-identical reports."""
+    a, _ = lint_project(REPO_ROOT, ["lighthouse_tpu", "tools"])
+    b, _ = lint_project(REPO_ROOT, ["lighthouse_tpu", "tools"])
+    assert [str(v) for v in a] == [str(v) for v in b]
+
+
+# --- suppression spans: decorators and multi-line statements ----------------
+
+
+def test_suppression_on_decorator_line_covers_the_function(tmp_path):
+    """Regression: `lint: allow[...]` on a decorator line used to be
+    ignored because the violation anchors at the `def` line and the
+    decorator line is not a pure comment line."""
+    vs = lint_fixture(
+        tmp_path, "crypto/bls/tpu/limbs.py",
+        """
+        import jax
+        import jax.numpy as jnp
+
+        @jax.jit  # lint: allow[limb-mask] -- fixture: carry handled upstream
+        def mul(a, b):
+            return jnp.stack([a * b])
+        """,
+    )
+    assert "limb-mask" not in rules_hit(vs)
+
+
+def test_suppression_in_comment_block_above_decorator(tmp_path):
+    vs = lint_fixture(
+        tmp_path, "crypto/bls/tpu/limbs.py",
+        """
+        import jax
+        import jax.numpy as jnp
+
+        # lint: allow[limb-mask] -- fixture: carry handled upstream
+        @jax.jit
+        def mul(a, b):
+            return jnp.stack([a * b])
+        """,
+    )
+    assert "limb-mask" not in rules_hit(vs)
+
+
+def test_suppression_without_comment_still_fires_when_decorated(tmp_path):
+    """Positive control for the decorator span: no comment, still flagged."""
+    vs = lint_fixture(
+        tmp_path, "crypto/bls/tpu/limbs.py",
+        """
+        import jax
+        import jax.numpy as jnp
+
+        @jax.jit
+        def mul(a, b):
+            return jnp.stack([a * b])
+        """,
+    )
+    assert "limb-mask" in rules_hit(vs)
+
+
+def test_suppression_on_later_line_of_multiline_statement(tmp_path):
+    """Regression: a statement spanning several lines is covered by an
+    allow-comment on ANY of its lines, not just the first."""
+    vs = lint_fixture(
+        tmp_path, "util/boot.py",
+        """
+        import time
+
+        TS = time.time(
+        )  # lint: allow[wallclock] -- fixture: multi-line statement
+        """,
+    )
+    assert "wallclock" not in rules_hit(vs)
+
+
+def test_suppression_span_does_not_leak_into_compound_bodies(tmp_path):
+    """An allow-comment INSIDE a compound statement's body must not
+    suppress a violation anchored at the header."""
+    vs = lint_fixture(
+        tmp_path, "util/loop.py",
+        """
+        import time
+
+        def f():
+            while True:
+                # lint: allow[retry-no-backoff] -- must NOT cover the loop
+                try:
+                    return 1
+                except OSError:
+                    time.sleep(1)
+        """,
+    )
+    assert "retry-no-backoff" in rules_hit(vs)
+
+
+# --- project CLI surface ----------------------------------------------------
+
+
+def test_cli_project_mode_clean_on_repo(capsys):
+    from tools.lint.__main__ import main
+
+    assert main(["--project"]) == 0
+    assert "lint clean" in capsys.readouterr().out
+
+
+def test_cli_sarif_output(tmp_path):
+    from tools.lint.__main__ import main
+
+    bad = tmp_path / "chain" / "bad.py"
+    bad.parent.mkdir(parents=True)
+    bad.write_text("X = 1.5\n")
+    out = tmp_path / "lint.sarif"
+    rc = main(
+        ["--root", str(tmp_path), "--no-baseline",
+         "--sarif", str(out), "chain"]
+    )
+    assert rc == 1
+    doc = json.loads(out.read_text())
+    assert doc["version"] == "2.1.0"
+    [run] = doc["runs"]
+    assert [r["ruleId"] for r in run["results"]] == ["float-consensus"]
+    [loc] = run["results"][0]["locations"]
+    assert loc["physicalLocation"]["artifactLocation"]["uri"] == (
+        "chain/bad.py"
+    )
+    rule_ids = {r["id"] for r in run["tool"]["driver"]["rules"]}
+    assert "float-consensus" in rule_ids
+
+
+def test_cli_sarif_empty_when_clean(tmp_path):
+    from tools.lint.__main__ import main
+
+    good = tmp_path / "chain" / "ok.py"
+    good.parent.mkdir(parents=True)
+    good.write_text("X = 1\n")
+    out = tmp_path / "lint.sarif"
+    assert main(
+        ["--root", str(tmp_path), "--no-baseline", "--project",
+         "--sarif", str(out), "chain"]
+    ) == 0
+    doc = json.loads(out.read_text())
+    assert doc["runs"][0]["results"] == []
+    # project rules appear in the tool metadata in project mode
+    assert "lock-order" in {
+        r["id"] for r in doc["runs"][0]["tool"]["driver"]["rules"]
+    }
+
+
+def test_cli_budget_blown_fails(tmp_path, capsys):
+    from tools.lint.__main__ import main
+
+    good = tmp_path / "chain" / "ok.py"
+    good.parent.mkdir(parents=True)
+    good.write_text("X = 1\n")
+    rc = main(
+        ["--root", str(tmp_path), "--no-baseline",
+         "--budget-s", "0", "chain"]
+    )
+    assert rc == 1
+    assert "budget" in capsys.readouterr().err
+
+
+def test_cli_changed_only_without_git_falls_back(tmp_path, capsys):
+    """No git repo at the root: warn and run the full tree (a fast path
+    must never silently skip everything)."""
+    from tools.lint.__main__ import main
+
+    bad = tmp_path / "chain" / "bad.py"
+    bad.parent.mkdir(parents=True)
+    bad.write_text("X = 1.5\n")
+    rc = main(
+        ["--root", str(tmp_path), "--no-baseline", "--changed-only",
+         "chain"]
+    )
+    captured = capsys.readouterr()
+    assert rc == 1
+    assert "falling back" in captured.err
+    assert "float-consensus" in captured.out
+
+
+def test_cli_changed_only_lints_only_changed_files(tmp_path, capsys):
+    import subprocess
+
+    from tools.lint.__main__ import main
+
+    subprocess.run(
+        ["git", "init", "-q"], cwd=tmp_path, check=True,
+    )
+    old = tmp_path / "chain" / "old.py"
+    old.parent.mkdir(parents=True)
+    old.write_text("X = 1.5\n")
+    subprocess.run(["git", "add", "-A"], cwd=tmp_path, check=True)
+    subprocess.run(
+        ["git", "-c", "user.email=t@t", "-c", "user.name=t",
+         "commit", "-qm", "seed"],
+        cwd=tmp_path, check=True,
+    )
+    new = tmp_path / "chain" / "new.py"
+    new.write_text("Y = 2.5\n")
+    rc = main(
+        ["--root", str(tmp_path), "--no-baseline", "--changed-only",
+         "chain"]
+    )
+    captured = capsys.readouterr()
+    assert rc == 1
+    assert "new.py" in captured.out
+    assert "old.py" not in captured.out  # committed debt: not this run's
+
+
+def test_cli_changed_only_clean_when_nothing_changed(tmp_path, capsys):
+    import subprocess
+
+    from tools.lint.__main__ import main
+
+    subprocess.run(["git", "init", "-q"], cwd=tmp_path, check=True)
+    f = tmp_path / "chain" / "old.py"
+    f.parent.mkdir(parents=True)
+    f.write_text("X = 1.5\n")
+    subprocess.run(["git", "add", "-A"], cwd=tmp_path, check=True)
+    subprocess.run(
+        ["git", "-c", "user.email=t@t", "-c", "user.name=t",
+         "commit", "-qm", "seed"],
+        cwd=tmp_path, check=True,
+    )
+    rc = main(
+        ["--root", str(tmp_path), "--no-baseline", "--changed-only",
+         "chain"]
+    )
+    assert rc == 0
+    assert "no changed python files" in capsys.readouterr().out

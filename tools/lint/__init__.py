@@ -7,9 +7,19 @@ in slot/balance arithmetic, deterministic iteration/randomness, no
 jit-recompile or host-sync hazards in the hot kernels, masked limb
 arithmetic, no swallowed exceptions at the processor/network layers.
 
-Run it as ``python -m tools.lint``. Pre-existing violations live in
-``tools/lint/baseline.json`` and are ratcheted: new violations fail,
-the baseline may only shrink.
+Run it as ``python -m tools.lint``; add ``--project`` (what ``make
+lint`` does) for the interprocedural catalogue built on a whole-tree
+ProjectIndex (``project.py``): lock-order cycles and table inversions,
+blocking calls reachable under a held lock, env-flag registry drift
+(``flags.json``), mesh-axis typos, metric families constructed outside
+``utils/metrics.py``, and wall-clock taint laundered through one call
+level into consensus/tracing code. Interprocedural findings carry
+their witness call chain. ``--sarif out.sarif`` emits GitHub-annotation
+output, ``--changed-only`` is the pre-commit fast path, and
+``--budget-s N`` fails runs that outgrow their wall-clock budget.
+
+Pre-existing violations live in ``tools/lint/baseline.json`` and are
+ratcheted: new violations fail, the baseline may only shrink.
 
 Suppressions (use sparingly, always with a reason):
 
@@ -24,4 +34,5 @@ See README.md "Static analysis" for the rule catalogue.
 """
 
 from .engine import Violation, lint_paths  # noqa: F401
+from .project import PROJECT_RULES, lint_project  # noqa: F401
 from .rules import ALL_RULES  # noqa: F401

@@ -1,13 +1,22 @@
 """CLI: ``python -m tools.lint [targets...]``.
 
-Exit codes: 0 clean (modulo baseline), 1 new violations or a stale
-baseline, 2 unparsable files.
+Exit codes: 0 clean (modulo baseline), 1 new violations, a stale
+baseline, or a blown --budget-s, 2 unparsable files.
+
+``--project`` adds the interprocedural pass (project.py) on top of the
+per-file rules, sharing a single parse of the tree. ``--changed-only``
+is the pre-commit fast path: per-file rules run only over files git
+reports as changed, and project findings are filtered to those files
+(the index still covers the whole tree — call graphs don't respect
+diffs).
 """
 
 from __future__ import annotations
 
 import argparse
+import subprocess
 import sys
+import time
 from pathlib import Path
 
 from .engine import (
@@ -16,14 +25,37 @@ from .engine import (
     iter_python_files,
     lint_paths,
     load_baseline,
+    parse_contexts,
     write_baseline,
 )
+from .project import FLAGS_REGISTRY, PROJECT_RULES, lint_project
 from .rules import ALL_RULES
 
 DEFAULT_TARGETS = ["lighthouse_tpu", "tools"]
 
 
+def _changed_files(root: Path) -> set[str] | None:
+    """Root-relative posix paths git considers changed, or None if git
+    is unavailable (caller falls back to a full run)."""
+    changed: set[str] = set()
+    for cmd in (
+        ["git", "diff", "--name-only", "HEAD"],
+        ["git", "ls-files", "--others", "--exclude-standard"],
+    ):
+        try:
+            out = subprocess.run(
+                cmd, cwd=root, capture_output=True, text=True, timeout=30,
+            )
+        except (OSError, subprocess.TimeoutExpired):
+            return None
+        if out.returncode != 0:
+            return None
+        changed.update(l.strip() for l in out.stdout.splitlines() if l.strip())
+    return changed
+
+
 def main(argv=None) -> int:
+    started = time.perf_counter()
     parser = argparse.ArgumentParser(
         prog="python -m tools.lint",
         description="lighthouse-lint: consensus-safety & TPU-hazard linter",
@@ -56,28 +88,109 @@ def main(argv=None) -> int:
         "--list-rules", action="store_true",
         help="print the rule catalogue and exit",
     )
+    parser.add_argument(
+        "--project", action="store_true",
+        help="also run the interprocedural project rules (whole-tree "
+             "index: lock-order, env-flag-drift, mesh-axis, ...)",
+    )
+    parser.add_argument(
+        "--sarif", type=Path, default=None, metavar="OUT",
+        help="write NEW (post-baseline) violations as SARIF 2.1.0",
+    )
+    parser.add_argument(
+        "--changed-only", action="store_true",
+        help="fast path: lint only files git reports as changed "
+             "(project findings filtered to those files)",
+    )
+    parser.add_argument(
+        "--budget-s", type=float, default=None, metavar="SECONDS",
+        help="fail (exit 1) if the whole run exceeds this wall-clock "
+             "budget",
+    )
     args = parser.parse_args(argv)
 
+    all_rules = list(ALL_RULES) + list(PROJECT_RULES)
     if args.list_rules:
         for rule in ALL_RULES:
             doc = (rule.__doc__ or "").strip().splitlines()[0]
-            print(f"{rule.id:18s} {doc}")
+            print(f"{rule.id:20s} {doc}")
+        for rule in PROJECT_RULES:
+            doc = (rule.__doc__ or "").strip().splitlines()[0]
+            print(f"{rule.id:20s} [project] {doc}")
         return 0
 
     root = args.root.resolve()
     targets = args.targets or DEFAULT_TARGETS
     baseline_path = args.baseline or root / "tools" / "lint" / "baseline.json"
 
+    changed: set[str] | None = None
+    if args.changed_only:
+        changed = _changed_files(root)
+        if changed is None:
+            print(
+                "warning: --changed-only: git unavailable, falling back "
+                "to a full run", file=sys.stderr,
+            )
+
     try:
-        scope = {
-            p.relative_to(root).as_posix()
-            for p in iter_python_files(root, targets)
-        }
+        all_files = list(iter_python_files(root, targets))
     except FileNotFoundError as e:
         print(f"error: {e}", file=sys.stderr)
         return 2
+    relpaths = {p: p.relative_to(root).as_posix() for p in all_files}
 
-    violations, errors = lint_paths(root, targets)
+    if changed is not None:
+        lint_files = [p for p in all_files if relpaths[p] in changed]
+    else:
+        lint_files = all_files
+    scope = {relpaths[p] for p in lint_files}
+
+    if changed is not None and not lint_files and not (
+        args.project and FLAGS_REGISTRY in changed
+    ):
+        print("lint clean: no changed python files")
+        return 0
+
+    violations: list = []
+    errors: list[str] = []
+    if args.project:
+        # one parse serves both passes; the project index always spans
+        # the FULL tree so cross-module reasoning sees unchanged callees
+        try:
+            ctxs, errors = parse_contexts(root, targets)
+        except FileNotFoundError as e:
+            print(f"error: {e}", file=sys.stderr)
+            return 2
+        per_file_ctxs = (
+            ctxs if changed is None
+            else [c for c in ctxs if c.path in scope]
+        )
+        v1, _ = lint_paths(root, targets, ctxs=per_file_ctxs)
+        v2, e2 = lint_project(root, targets, ctxs=ctxs)
+        errors.extend(e2)
+        if changed is not None:
+            v2 = [
+                v for v in v2
+                if v.path in scope or v.path == FLAGS_REGISTRY
+            ]
+            scope = scope | {FLAGS_REGISTRY}
+        elif any(v.path == FLAGS_REGISTRY for v in v2):
+            scope = scope | {FLAGS_REGISTRY}
+        violations = sorted(
+            v1 + v2, key=lambda v: (v.path, v.line, v.rule, v.message)
+        )
+    else:
+        if changed is not None:
+            v_all: list = []
+            for p in lint_files:
+                vs, es = lint_paths(root, [relpaths[p]])
+                v_all.extend(vs)
+                errors.extend(es)
+            violations = sorted(
+                v_all, key=lambda v: (v.path, v.line, v.rule)
+            )
+        else:
+            violations, errors = lint_paths(root, targets)
     for e in errors:
         print(f"error: {e}", file=sys.stderr)
 
@@ -99,6 +212,12 @@ def main(argv=None) -> int:
 
     baseline = {} if args.no_baseline else load_baseline(baseline_path)
     new, stale = apply_baseline(violations, baseline, scope_files=scope)
+
+    if args.sarif is not None:
+        from .sarif import write_sarif
+
+        ran_rules = all_rules if args.project else list(ALL_RULES)
+        write_sarif(args.sarif, new, ran_rules)
 
     for v in new:
         print(v)
@@ -125,6 +244,14 @@ def main(argv=None) -> int:
         return 1
     if errors:
         return 2
+    elapsed = time.perf_counter() - started
+    if args.budget_s is not None and elapsed > args.budget_s:
+        print(
+            f"FAILED: lint took {elapsed:.2f}s, over the "
+            f"--budget-s {args.budget_s:.2f}s budget",
+            file=sys.stderr,
+        )
+        return 1
     print(f"lint clean: {len(violations)} total, all grandfathered or zero")
     return 0
 

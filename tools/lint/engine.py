@@ -84,10 +84,49 @@ class LintContext:
             ln -= 1
         return False
 
+    def _suppression_span(self, node: ast.AST) -> tuple[int, int]:
+        """Lines whose allow-comments cover `node`.
+
+        A plain (possibly multi-line) statement is addressed by any of
+        its lines. A compound statement — incl. a decorated def/class —
+        is addressed only by its HEADER lines (decorators, signature,
+        test/iter expressions), never by lines of its body: a comment
+        inside the body must not suppress a finding about the statement
+        itself.
+        """
+        line = getattr(node, "lineno", 1)
+        end = getattr(node, "end_lineno", line) or line
+        body = getattr(node, "body", None)
+        if not (isinstance(body, list) and body
+                and hasattr(body[0], "lineno")):
+            return line, max(end, line)
+        start = stop = line
+        for field, value in ast.iter_fields(node):
+            if field in ("body", "orelse", "finalbody", "handlers"):
+                continue
+            items = value if isinstance(value, list) else [value]
+            for v in items:
+                if not isinstance(v, ast.AST):
+                    continue
+                for sub in ast.walk(v):
+                    ln = getattr(sub, "lineno", None)
+                    e = getattr(sub, "end_lineno", None)
+                    if ln:
+                        start = min(start, ln)
+                    if e:
+                        stop = max(stop, e)
+        return start, max(stop, start)
+
+    def suppressed_node(self, rule_id: str, node: ast.AST) -> bool:
+        start, stop = self._suppression_span(node)
+        return any(
+            self.suppressed(rule_id, ln) for ln in range(start, stop + 1)
+        )
+
     def violation(self, rule_id: str, node: ast.AST, message: str):
         """Build a Violation unless suppressed; rules yield the result."""
         line = getattr(node, "lineno", 1)
-        if self.suppressed(rule_id, line):
+        if self.suppressed_node(rule_id, node):
             return None
         return Violation(rule_id, self.path, line, message)
 
@@ -120,24 +159,41 @@ def iter_python_files(root: Path, targets: list[str] | None = None):
             yield p
 
 
-def lint_paths(root: Path, targets: list[str] | None = None, rules=None):
-    """Lint files under root; returns (violations, parse_errors)."""
+def parse_contexts(root: Path, targets: list[str] | None = None):
+    """Parse every lintable file once; returns (ctxs, parse_errors).
+
+    Raises FileNotFoundError for bad targets (callers that want the
+    soft-error behavior go through lint_paths).
+    """
+    ctxs: list[LintContext] = []
+    errors: list[str] = []
+    for path in iter_python_files(root, targets):
+        try:
+            source = path.read_text(encoding="utf-8")
+            ctxs.append(LintContext(root, path, source))
+        except (SyntaxError, UnicodeDecodeError, ValueError) as e:
+            errors.append(f"{path}: unparsable: {e}")
+    return ctxs, errors
+
+
+def lint_paths(root: Path, targets: list[str] | None = None, rules=None,
+               ctxs: list[LintContext] | None = None):
+    """Lint files under root; returns (violations, parse_errors).
+
+    Pass pre-parsed `ctxs` (from parse_contexts) to share one parse
+    between the per-file pass and the project pass.
+    """
     from .rules import ALL_RULES
 
     rules = list(rules) if rules is not None else list(ALL_RULES)
     violations: list[Violation] = []
     errors: list[str] = []
-    try:
-        files = list(iter_python_files(root, targets))
-    except FileNotFoundError as e:
-        return [], [str(e)]
-    for path in files:
+    if ctxs is None:
         try:
-            source = path.read_text(encoding="utf-8")
-            ctx = LintContext(root, path, source)
-        except (SyntaxError, UnicodeDecodeError, ValueError) as e:
-            errors.append(f"{path}: unparsable: {e}")
-            continue
+            ctxs, errors = parse_contexts(root, targets)
+        except FileNotFoundError as e:
+            return [], [str(e)]
+    for ctx in ctxs:
         for rule in rules:
             violations.extend(v for v in rule.check(ctx) if v is not None)
     violations.sort(key=lambda v: (v.path, v.line, v.rule))

@@ -1,0 +1,1310 @@
+"""Whole-program analysis: the ProjectIndex and the interprocedural rules.
+
+The per-file rules in rules.py see one module at a time; the bug classes
+that threaten the stack now are cross-module — a lock acquired in
+``store/hot_cold.py`` while a ``store/kv.py`` journal lock is taken in a
+callee, an env flag read in ``crypto/`` that no registry documents, a
+``PartitionSpec`` axis name that no mesh declares. This module parses
+the whole tree ONCE into a :class:`ProjectIndex` (module graph,
+per-function symbol table, approximate call graph — name/attribute
+resolution within the package, conservative on dynamic dispatch) and
+runs the project rules over it.
+
+Project rules have the same shape as per-file rules (``id``, docstring,
+``check``) but ``check`` takes the index, not one file; violations are
+anchored at a concrete (file, line) so the suppression and baseline
+machinery apply unchanged. Interprocedural findings carry their witness
+call chain in the message, e.g.::
+
+    store/hot_cold.py:349: [blocking-under-lock] os.fsync() reachable
+    while HotColdDB._mutation_lock is held (witness:
+    migrate_to_freezer -> kv.py::KeyValueStore.do_atomically ->
+    kv.py::FileStore.put -> os.fsync)
+
+Call-graph resolution, in decreasing confidence:
+
+  * bare names -> same-module functions/classes, then from-imports
+  * ``self.meth()`` -> methods of the enclosing class (single-level
+    base-class walk within the index)
+  * ``mod.func()`` / ``pkg.mod.func()`` -> imported-module attributes
+    (longest-prefix match over indexed modules)
+  * anything else (``obj.meth()`` on an unknown receiver) falls back to
+    a NAME match only when exactly one indexed function bears that
+    method name and the name is distinctive (not in _GENERIC_METHODS);
+    otherwise the call is left unresolved — conservative on dynamic
+    dispatch by design.
+"""
+
+from __future__ import annotations
+
+import ast
+import json
+import re
+from pathlib import Path
+
+from .engine import LintContext, parse_contexts
+
+# --------------------------------------------------------------------------
+# authoritative tables
+# --------------------------------------------------------------------------
+
+#: Known locks, OUTERMOST FIRST: a thread holding a lock may only
+#: acquire locks that appear LATER in this list. The list codifies the
+#: orderings the stack actually relies on; lock-order fails on any edge
+#: that contradicts it (and on any cycle, table or not). Locks are
+#: keyed ``ClassName.attr`` (instance locks) / ``module_stem.NAME``
+#: (module globals).
+LOCK_ORDER: tuple[str, ...] = (
+    # freezer mutations (migrate/reconstruct/prune) stage journaled
+    # batches: the mutation lock is held ACROSS do_atomically
+    "HotColdDB._mutation_lock",
+    # processor scheduling may enqueue work that lands in store batches,
+    # never the reverse
+    "BeaconProcessor._lock",
+    # bus fan-out holds the bus lock around subscriber snapshots only
+    "WireBus._lock",
+    # the journal lock: one intent row per store, innermost of the
+    # store-side locks
+    "KeyValueStore._batch_lock",
+    "NativeStore._lock",
+    # leaf utility locks — nothing is ever acquired under these
+    "ResponseCache._lock",
+    "EventBroadcaster._lock",
+    "Registry._lock",
+)
+
+#: Mesh axis names every `PartitionSpec`/`psum`/`all_gather` must use
+#: (parallel/verify_sharded.py declares both meshes). Fixture trees may
+#: extend this implicitly by declaring their own `Mesh(..., (names,))`.
+MESH_AXES: frozenset[str] = frozenset({"sets", "validators"})
+
+#: Flag registry location, relative to the lint root.
+FLAGS_REGISTRY = "tools/lint/flags.json"
+
+#: Env var names the env-flag-drift rule governs.
+FLAG_PATTERN = re.compile(r"^(LIGHTHOUSE_TPU|JAX)_[A-Z0-9_]+$")
+
+#: Method names too generic to resolve by name alone.
+_GENERIC_METHODS = frozenset({
+    "get", "put", "set", "add", "pop", "run", "stop", "start", "close",
+    "open", "read", "write", "send", "recv", "push", "clear", "copy",
+    "update", "append", "extend", "remove", "delete", "keys", "values",
+    "items", "submit", "next", "result", "done", "wait", "notify",
+    "notify_all", "acquire", "release", "join", "flush", "encode",
+    "decode", "load", "dump", "reset", "check", "handle", "process",
+    "name", "size", "count", "exists", "insert", "commit", "stage",
+})
+
+_LOCKISH = re.compile(r"(^|_)(lock|mutex|cond)", re.IGNORECASE)
+
+
+def _lock_ctor_kind(leaf: str) -> str | None:
+    """Lock kind for a constructor class name, or None if not a lock.
+
+    Wrapper classes count by suffix: ``TimeoutRLock`` is reentrant
+    (self-edges legal), an unknown ``*Lock`` gets kind "unknown" so
+    nesting is tracked but no single-thread-deadlock claim is made.
+    """
+    if leaf == "Lock":
+        return "lock"
+    if leaf == "RLock" or leaf.endswith("RLock"):
+        return "rlock"
+    if leaf == "Condition":
+        return "cond"
+    if leaf in ("Semaphore", "BoundedSemaphore"):
+        return "unknown"
+    if leaf.endswith("Lock"):
+        return "unknown"
+    return None
+
+_WALL_READS = {
+    ("time", "time"), ("datetime", "now"), ("datetime", "utcnow"),
+    ("datetime", "today"), ("date", "today"),
+}
+
+_BLOCKING_DOTTED = {
+    "time.sleep": "time.sleep",
+    "_time.sleep": "time.sleep",
+    "os.fsync": "os.fsync",
+    "_os.fsync": "os.fsync",
+    "socket.create_connection": "socket.create_connection",
+    "socket.socket": "socket.socket",
+    "subprocess.run": "subprocess.run",
+    "subprocess.call": "subprocess.call",
+    "subprocess.check_call": "subprocess.check_call",
+    "subprocess.check_output": "subprocess.check_output",
+    "subprocess.Popen": "subprocess.Popen",
+    "urllib.request.urlopen": "urllib.request.urlopen",
+    "requests.get": "requests.get",
+    "requests.post": "requests.post",
+    "requests.request": "requests.request",
+    "jax.device_get": "jax.device_get",
+}
+
+#: attribute-only blocking leaves (receiver unknown): device syncs
+_BLOCKING_ATTRS = {"block_until_ready", "fsync"}
+
+_METRIC_CLASSES = frozenset({"Counter", "Gauge", "Histogram", "LabeledGauge"})
+_METRIC_FACTORIES = frozenset({"counter", "gauge", "histogram", "labeled_gauge"})
+
+_COLLECTIVES = {
+    # leaf -> 0-based index of the axis-name positional operand
+    "psum": 1, "pmean": 1, "pmax": 1, "pmin": 1,
+    "all_gather": 1, "psum_scatter": 1, "ppermute": 1,
+    "axis_index": 0, "axis_size": 0, "all_to_all": 1,
+}
+
+
+def _dotted(node) -> str | None:
+    parts = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+        return ".".join(reversed(parts))
+    return None
+
+
+def _iter_own(nodes, *, enter_classes=False):
+    """Iterate statements/expressions without descending into nested
+    function definitions (and, unless asked, class bodies). The roots
+    themselves are always descended into."""
+    stack = []
+    for root in nodes:
+        stack.extend(ast.iter_child_nodes(root))
+    while stack:
+        node = stack.pop()
+        yield node
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef, ast.Lambda)):
+            continue
+        if isinstance(node, ast.ClassDef) and not enter_classes:
+            continue
+        stack.extend(ast.iter_child_nodes(node))
+
+
+# --------------------------------------------------------------------------
+# the index
+# --------------------------------------------------------------------------
+
+
+class FuncInfo:
+    """One function/method (or a module's top-level code as ``<module>``)."""
+
+    __slots__ = (
+        "module", "path", "cls", "name", "node", "ctx",
+        "callees", "lock_events", "acquired", "blocking", "wall_reads",
+    )
+
+    def __init__(self, module, path, cls, name, node, ctx):
+        self.module = module          # dotted module name
+        self.path = path              # root-relative posix path
+        self.cls = cls                # enclosing class name or None
+        self.name = name              # function name or "<module>"
+        self.node = node              # FunctionDef | Module
+        self.ctx = ctx                # the file's LintContext
+        self.callees: list = []       # (FuncInfo, ast.Call)
+        self.lock_events: list = []   # (held: tuple, kind, payload, node)
+        self.acquired: set = set()    # lock keys acquired anywhere inside
+        self.blocking: list = []      # (display_name, ast.Call) direct
+        self.wall_reads: list = []    # (display_name, node) direct
+
+    @property
+    def qualname(self) -> str:
+        base = f"{self.cls}.{self.name}" if self.cls else self.name
+        return f"{self.path}::{base}"
+
+    def __repr__(self):  # pragma: no cover - debug aid
+        return f"<FuncInfo {self.qualname}>"
+
+
+class ModuleInfo:
+    __slots__ = (
+        "path", "modname", "ctx", "imports", "constants",
+        "functions", "classes", "module_func",
+    )
+
+    def __init__(self, path, modname, ctx):
+        self.path = path
+        self.modname = modname
+        self.ctx = ctx
+        # local name -> ("module", dotted) | ("symbol", module_dotted, orig)
+        self.imports: dict[str, tuple] = {}
+        self.constants: dict[str, str] = {}   # NAME = "literal"
+        self.functions: dict[str, FuncInfo] = {}
+        # class name -> {"methods": {...}, "bases": [...], "locks": {...}}
+        self.classes: dict[str, dict] = {}
+        self.module_func: FuncInfo | None = None
+
+
+class ProjectIndex:
+    """Module graph + symbol tables + approximate call graph for one tree."""
+
+    def __init__(self, root: Path, ctxs: list[LintContext]):
+        self.root = root
+        self.ctxs = sorted(ctxs, key=lambda c: c.path)
+        self.modules: dict[str, ModuleInfo] = {}
+        self.by_path: dict[str, ModuleInfo] = {}
+        self.functions: list[FuncInfo] = []
+        self.methods_by_name: dict[str, list[FuncInfo]] = {}
+        self.classes_by_name: dict[str, list[tuple[ModuleInfo, str]]] = {}
+        self.lock_kinds: dict[str, str] = {}   # lock key -> lock/rlock/cond
+        self.callers: dict[int, list] = {}     # id(FuncInfo)->[(FuncInfo,Call)]
+        self._acq_closure: dict[int, dict] = {}
+        self._blocking_closure: dict[int, dict] = {}
+        self._build()
+
+    # -- construction ------------------------------------------------------
+
+    @staticmethod
+    def _modname(relpath: str) -> str:
+        parts = relpath[:-3].split("/")  # strip .py
+        if parts[-1] == "__init__":
+            parts = parts[:-1]
+        return ".".join(parts) or "<root>"
+
+    def _build(self):
+        for ctx in self.ctxs:
+            mod = ModuleInfo(ctx.path, self._modname(ctx.path), ctx)
+            self.modules[mod.modname] = mod
+            self.by_path[ctx.path] = mod
+        for mod in self.modules.values():
+            self._index_module(mod)
+        for mod in self.modules.values():
+            self._resolve_imports(mod)
+        for fi in self.functions:
+            self._analyze_function(fi)
+        for fi in self.functions:
+            for callee, call in fi.callees:
+                self.callers.setdefault(id(callee), []).append((fi, call))
+
+    def _index_module(self, mod: ModuleInfo):
+        tree = mod.ctx.tree
+        mod.module_func = FuncInfo(
+            mod.modname, mod.path, None, "<module>", tree, mod.ctx
+        )
+        self.functions.append(mod.module_func)
+        for node in tree.body:
+            if isinstance(node, ast.Assign) and len(node.targets) == 1:
+                t = node.targets[0]
+                if isinstance(t, ast.Name) and isinstance(
+                    node.value, ast.Constant
+                ) and isinstance(node.value.value, str):
+                    mod.constants[t.id] = node.value.value
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                fi = FuncInfo(
+                    mod.modname, mod.path, None, node.name, node, mod.ctx
+                )
+                mod.functions[node.name] = fi
+                self.functions.append(fi)
+            elif isinstance(node, ast.ClassDef):
+                info = {"methods": {}, "bases": [], "locks": {}}
+                for b in node.bases:
+                    d = _dotted(b)
+                    if d:
+                        info["bases"].append(d.split(".")[-1])
+                for item in node.body:
+                    if isinstance(item, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                        fi = FuncInfo(
+                            mod.modname, mod.path, node.name, item.name,
+                            item, mod.ctx,
+                        )
+                        info["methods"][item.name] = fi
+                        self.functions.append(fi)
+                        self.methods_by_name.setdefault(
+                            item.name, []
+                        ).append(fi)
+                        self._collect_lock_defs(node.name, fi, info)
+                mod.classes[node.name] = info
+                self.classes_by_name.setdefault(node.name, []).append(
+                    (mod, node.name)
+                )
+
+    def _collect_lock_defs(self, clsname: str, fi: FuncInfo, info: dict):
+        """Record ``self.X = threading.Lock()/RLock()/Condition(...)``."""
+        for node in _iter_own([fi.node]):
+            if not isinstance(node, ast.Assign):
+                continue
+            if not isinstance(node.value, ast.Call):
+                continue
+            leaf = (_dotted(node.value.func) or "").split(".")[-1]
+            kind = _lock_ctor_kind(leaf)
+            if kind is None:
+                continue
+            for t in node.targets:
+                if (
+                    isinstance(t, ast.Attribute)
+                    and isinstance(t.value, ast.Name)
+                    and t.value.id == "self"
+                ):
+                    key = f"{clsname}.{t.attr}"
+                    if leaf == "Condition" and node.value.args:
+                        # Condition(self._lock) ALIASES the wrapped lock
+                        inner = _dotted(node.value.args[0]) or ""
+                        if inner.startswith("self."):
+                            info["locks"][t.attr] = (
+                                "alias", inner.split(".", 1)[1]
+                            )
+                            continue
+                    info["locks"][t.attr] = ("lock", kind)
+                    self.lock_kinds[key] = kind
+
+    def _resolve_imports(self, mod: ModuleInfo):
+        pkg_parts = mod.modname.split(".")
+        is_pkg = mod.path.endswith("__init__.py")
+        base_parts = pkg_parts if is_pkg else pkg_parts[:-1]
+        for node in ast.walk(mod.ctx.tree):
+            if isinstance(node, ast.Import):
+                for a in node.names:
+                    bound = a.asname or a.name.split(".")[0]
+                    target = a.name if a.asname else a.name.split(".")[0]
+                    mod.imports[bound] = ("module", target)
+            elif isinstance(node, ast.ImportFrom):
+                if node.level:
+                    up = base_parts[: len(base_parts) - (node.level - 1)]
+                    src = ".".join(up + ([node.module] if node.module else []))
+                else:
+                    src = node.module or ""
+                for a in node.names:
+                    bound = a.asname or a.name
+                    child = f"{src}.{a.name}" if src else a.name
+                    if child in self.modules:
+                        mod.imports[bound] = ("module", child)
+                    else:
+                        mod.imports[bound] = ("symbol", src, a.name)
+        # module-level lock globals: X = threading.Lock()
+        stem = mod.path.rsplit("/", 1)[-1][:-3]
+        for node in mod.ctx.tree.body:
+            if isinstance(node, ast.Assign) and isinstance(
+                node.value, ast.Call
+            ):
+                leaf = (_dotted(node.value.func) or "").split(".")[-1]
+                kind = _lock_ctor_kind(leaf)
+                if kind is not None and leaf != "Condition":
+                    for t in node.targets:
+                        if isinstance(t, ast.Name):
+                            self.lock_kinds[f"{stem}.{t.id}"] = kind
+
+    # -- call resolution ---------------------------------------------------
+
+    def _lookup_method(self, mod: ModuleInfo, clsname: str, meth: str,
+                       depth: int = 0):
+        info = mod.classes.get(clsname)
+        if info is None or depth > 4:
+            return None
+        fi = info["methods"].get(meth)
+        if fi is not None:
+            return fi
+        for base in info["bases"]:
+            for bmod, bname in self.classes_by_name.get(base, []):
+                hit = self._lookup_method(bmod, bname, meth, depth + 1)
+                if hit is not None:
+                    return hit
+        return None
+
+    def _class_target(self, mod: ModuleInfo, clsname: str):
+        """Resolve a class NAME visible in `mod` to (owner_mod, clsname)."""
+        if clsname in mod.classes:
+            return mod, clsname
+        imp = mod.imports.get(clsname)
+        if imp and imp[0] == "symbol":
+            owner = self.modules.get(imp[1])
+            if owner and imp[2] in owner.classes:
+                return owner, imp[2]
+        return None
+
+    def resolve_call(self, fi: FuncInfo, call: ast.Call) -> list[FuncInfo]:
+        dotted = _dotted(call.func)
+        if not dotted:
+            return []
+        mod = self.by_path[fi.path]
+        parts = dotted.split(".")
+        if len(parts) == 1:
+            name = parts[0]
+            if name in mod.functions:
+                return [mod.functions[name]]
+            hit = self._class_target(mod, name)
+            if hit:
+                owner, cls = hit
+                init = self._lookup_method(owner, cls, "__init__")
+                return [init] if init else []
+            imp = mod.imports.get(name)
+            if imp and imp[0] == "symbol":
+                owner = self.modules.get(imp[1])
+                if owner and imp[2] in owner.functions:
+                    return [owner.functions[imp[2]]]
+            return []
+        if parts[0] == "self" and fi.cls and len(parts) == 2:
+            hit = self._lookup_method(mod, fi.cls, parts[1])
+            return [hit] if hit else self._name_fallback(parts[1])
+        if parts[0] == "cls" and fi.cls and len(parts) == 2:
+            hit = self._lookup_method(mod, fi.cls, parts[1])
+            return [hit] if hit else []
+        # ClassName.method (staticmethod / unbound call)
+        if len(parts) == 2:
+            hit = self._class_target(mod, parts[0])
+            if hit:
+                meth = self._lookup_method(hit[0], hit[1], parts[1])
+                return [meth] if meth else []
+        # module-attribute chains: alias.f(), pkg.mod.f()
+        imp = mod.imports.get(parts[0])
+        if imp and imp[0] == "module":
+            dotted_mod = imp[1]
+            rest = parts[1:]
+            while len(rest) > 1 and f"{dotted_mod}.{rest[0]}" in self.modules:
+                dotted_mod = f"{dotted_mod}.{rest[0]}"
+                rest = rest[1:]
+            owner = self.modules.get(dotted_mod)
+            if owner and len(rest) == 1:
+                if rest[0] in owner.functions:
+                    return [owner.functions[rest[0]]]
+                if rest[0] in owner.classes:
+                    init = self._lookup_method(owner, rest[0], "__init__")
+                    return [init] if init else []
+            return []
+        return self._name_fallback(parts[-1])
+
+    def _name_fallback(self, meth: str) -> list[FuncInfo]:
+        if meth in _GENERIC_METHODS or meth.startswith("__") or len(meth) < 4:
+            return []
+        hits = self.methods_by_name.get(meth, [])
+        return list(hits) if len(hits) == 1 else []
+
+    # -- per-function analysis ---------------------------------------------
+
+    def _lock_key(self, fi: FuncInfo, expr, local_locks: dict) -> str | None:
+        """Canonical lock key for an acquired expression, or None."""
+        mod = self.by_path[fi.path]
+        stem = fi.path.rsplit("/", 1)[-1][:-3]
+        if isinstance(expr, ast.Name):
+            if expr.id in local_locks:
+                return local_locks[expr.id]
+            if f"{stem}.{expr.id}" in self.lock_kinds:
+                return f"{stem}.{expr.id}"
+            if _LOCKISH.search(expr.id):
+                # a local variable that LOOKS like a lock but has no
+                # resolvable definition: attribute it to the function's
+                # own scope so nesting is still visible
+                return f"{stem}.{expr.id}"
+            return None
+        if (
+            isinstance(expr, ast.Attribute)
+            and isinstance(expr.value, ast.Name)
+            and expr.value.id == "self"
+            and fi.cls
+        ):
+            attr = expr.attr
+            info = mod.classes.get(fi.cls, {"locks": {}})
+            seen = set()
+            while attr in info["locks"] and attr not in seen:
+                seen.add(attr)
+                entry = info["locks"][attr]
+                if entry[0] == "alias":
+                    attr = entry[1]
+                else:
+                    break
+            key = f"{fi.cls}.{attr}"
+            if key in self.lock_kinds or attr in info["locks"]:
+                return key
+            if _LOCKISH.search(attr):
+                return key
+            return None
+        d = _dotted(expr)
+        if d and _LOCKISH.search(d.split(".")[-1]):
+            return d.split(".")[-1] if "." not in d else (
+                f"{fi.cls}.{d.split('.')[-1]}" if fi.cls else d
+            )
+        return None
+
+    def _wall_read_name(self, fi: FuncInfo, call: ast.Call) -> str | None:
+        dotted = _dotted(call.func)
+        if not dotted:
+            return None
+        parts = dotted.split(".")
+        tf = getattr(fi.ctx, "_time_froms", None)
+        if tf is None:
+            from .rules import _import_bindings
+            fi.ctx._time_aliases, fi.ctx._time_froms = _import_bindings(
+                fi.ctx.tree, "time"
+            )
+            _a, fi.ctx._dt_froms = _import_bindings(fi.ctx.tree, "datetime")
+            tf = fi.ctx._time_froms
+        if len(parts) == 1:
+            if tf.get(parts[0]) == "time":
+                return "time.time"
+            return None
+        head, tail = parts[-2], parts[-1]
+        head = fi.ctx._dt_froms.get(head, head)
+        if head in fi.ctx._time_aliases or head in ("time", "_time"):
+            head = "time"
+        if (head, tail) in _WALL_READS:
+            return f"{head}.{tail}"
+        return None
+
+    def _blocking_name(self, call: ast.Call) -> str | None:
+        dotted = _dotted(call.func)
+        if not dotted:
+            return None
+        hit = _BLOCKING_DOTTED.get(dotted)
+        if hit:
+            return hit
+        leaf = dotted.split(".")[-1]
+        if leaf in _BLOCKING_ATTRS:
+            return leaf + "()"
+        return None
+
+    def _analyze_function(self, fi: FuncInfo):
+        """One walk: callees, lock events, direct blocking + wall reads."""
+        local_locks: dict[str, str] = {}
+
+        def visit(stmts, held: tuple):
+            for stmt in stmts:
+                visit_node(stmt, held)
+
+        def scan_expr(node, held):
+            """Record calls in an expression tree (no new lock scopes)."""
+            for sub in ast.walk(node):
+                if isinstance(sub, ast.Call):
+                    record_call(sub, held)
+                elif isinstance(
+                    sub, (ast.FunctionDef, ast.AsyncFunctionDef)
+                ):
+                    # nested defs: include their calls (closures run in
+                    # this scope's service) but never their lock state
+                    for inner in ast.walk(sub):
+                        if isinstance(inner, ast.Call):
+                            record_call(inner, ())
+
+        def record_call(call, held):
+            targets = self.resolve_call(fi, call)
+            for t in targets:
+                fi.callees.append((t, call))
+            wall = self._wall_read_name(fi, call)
+            if wall:
+                fi.wall_reads.append((wall, call))
+            blocking = self._blocking_name(call)
+            if blocking:
+                fi.blocking.append((blocking, call))
+            if held:
+                fi.lock_events.append((held, "call", (targets, blocking), call))
+
+        def visit_node(node, held):
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                 ast.Lambda, ast.ClassDef)):
+                if fi.name == "<module>" and isinstance(node, ast.ClassDef):
+                    visit(node.body, held)
+                    return
+                scan_expr(node, ())
+                return
+            if isinstance(node, (ast.With, ast.AsyncWith)):
+                inner = held
+                for item in node.items:
+                    lock = self._lock_key(fi, item.context_expr, local_locks)
+                    scan_expr(item.context_expr, inner)
+                    if lock:
+                        fi.acquired.add(lock)
+                        fi.lock_events.append(
+                            (inner, "acquire", lock, item.context_expr)
+                        )
+                        inner = inner + (lock,)
+                visit(node.body, inner)
+                return
+            if isinstance(node, ast.Assign):
+                # track `lock = self._x` style aliases, plus the lazy
+                # `self.__dict__.setdefault("_batch_lock", Lock())` idiom
+                if len(node.targets) == 1 and isinstance(
+                    node.targets[0], ast.Name
+                ):
+                    name = node.targets[0].id
+                    key = self._lock_key(fi, node.value, local_locks)
+                    if key:
+                        local_locks[name] = key
+                    elif isinstance(node.value, ast.Call):
+                        d = _dotted(node.value.func) or ""
+                        if "__dict__" in d and d.split(".")[-1] in (
+                            "get", "setdefault"
+                        ):
+                            for a in node.value.args:
+                                if isinstance(a, ast.Constant) and isinstance(
+                                    a.value, str
+                                ) and _LOCKISH.search(a.value):
+                                    owner = fi.cls or fi.path.rsplit(
+                                        "/", 1
+                                    )[-1][:-3]
+                                    local_locks[name] = f"{owner}.{a.value}"
+                scan_expr(node.value, held)
+                return
+            if isinstance(node, ast.Expr) and isinstance(node.value, ast.Call):
+                call = node.value
+                d = _dotted(call.func) or ""
+                if d.endswith(".acquire"):
+                    lock = self._lock_key(
+                        fi, call.func.value, local_locks
+                    )
+                    if lock:
+                        fi.acquired.add(lock)
+                        fi.lock_events.append((held, "acquire", lock, call))
+                scan_expr(call, held)
+                return
+            # compound statements keep the held set for their bodies
+            if isinstance(node, (ast.If, ast.While)):
+                scan_expr(node.test, held)
+                visit(node.body, held)
+                visit(node.orelse, held)
+                return
+            if isinstance(node, (ast.For, ast.AsyncFor)):
+                scan_expr(node.iter, held)
+                visit(node.body, held)
+                visit(node.orelse, held)
+                return
+            if isinstance(node, ast.Try):
+                visit(node.body, held)
+                for h in node.handlers:
+                    visit(h.body, held)
+                visit(node.orelse, held)
+                visit(node.finalbody, held)
+                return
+            scan_expr(node, held)
+
+        body = (
+            fi.node.body
+            if isinstance(fi.node, (ast.FunctionDef, ast.AsyncFunctionDef))
+            else [
+                n for n in fi.node.body
+                if not isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef))
+            ]
+        )
+        visit(body, ())
+
+    # -- transitive closures -----------------------------------------------
+
+    def _closure(self, cache: dict, fi: FuncInfo, attr: str) -> dict:
+        """Map of payload -> witness chain (list of FuncInfo) reachable
+        from ``fi`` through the call graph. ``attr`` names the local
+        payload list/set on FuncInfo ("acquired" or "blocking")."""
+        memo = cache.get(id(fi))
+        if memo is not None:
+            return memo
+        cache[id(fi)] = result = {}
+        seen = {id(fi)}
+        queue = [(fi, [fi])]
+        while queue:
+            cur, chain = queue.pop(0)
+            payload = getattr(cur, attr)
+            items = (
+                sorted(payload) if isinstance(payload, set)
+                else [p for p, _n in payload]
+            )
+            for item in items:
+                if item not in result:
+                    result[item] = chain
+            if len(chain) >= 8:
+                continue
+            for callee, _call in cur.callees:
+                if id(callee) in seen:
+                    continue
+                seen.add(id(callee))
+                queue.append((callee, chain + [callee]))
+        return result
+
+    def acquires_transitively(self, fi: FuncInfo) -> dict:
+        return self._closure(self._acq_closure, fi, "acquired")
+
+    def blocks_transitively(self, fi: FuncInfo) -> dict:
+        return self._closure(self._blocking_closure, fi, "blocking")
+
+
+def _chain_str(chain: list[FuncInfo], tail: str | None = None) -> str:
+    parts = [c.qualname for c in chain]
+    if tail:
+        parts.append(tail)
+    return " -> ".join(parts)
+
+
+# --------------------------------------------------------------------------
+# rules
+# --------------------------------------------------------------------------
+
+
+class LockOrderRule:
+    """lock-order: the cross-module lock-acquisition graph must be acyclic
+    and respect the authoritative ordering table.
+
+    Each ``with lock:`` / ``lock.acquire()`` nested (directly, or through
+    any call chain) inside another lock's scope contributes an edge
+    held -> acquired. A cycle in that graph is a latent deadlock: two
+    threads entering the cycle from different points block each other
+    forever. For the known locks (LOCK_ORDER, outermost first), any edge
+    that acquires an EARLIER lock while holding a LATER one fails even
+    without a full cycle — the table is the contract the next subsystem
+    builds against. Re-acquiring a non-reentrant Lock through a call
+    chain (a self-edge) is an instant single-thread deadlock and is
+    flagged too. Violations carry the witness call chain.
+    """
+
+    id = "lock-order"
+
+    def __init__(self, order: tuple[str, ...] = LOCK_ORDER):
+        self.order = order
+
+    def _edges(self, index: ProjectIndex):
+        """yield (held, acquired, anchor_fi, anchor_node, chain, via)"""
+        for fi in index.functions:
+            for held, kind, payload, node in fi.lock_events:
+                if not held:
+                    continue
+                if kind == "acquire":
+                    yield held[-1], payload, fi, node, [fi], None
+                elif kind == "call":
+                    targets, _blocking = payload
+                    for t in targets:
+                        closure = index.acquires_transitively(t)
+                        for lock, chain in sorted(closure.items()):
+                            yield held[-1], lock, fi, node, [fi] + chain, t
+
+    def check(self, index: ProjectIndex):
+        levels = {name: i for i, name in enumerate(self.order)}
+        graph: dict[str, dict[str, tuple]] = {}
+        for held, acq, fi, node, chain, _via in self._edges(index):
+            graph.setdefault(held, {})
+            if acq not in graph[held]:
+                graph[held][acq] = (fi, node, chain)
+        reported = set()
+        # table violations + self-deadlocks, keyed on concrete edges
+        for held in sorted(graph):
+            for acq in sorted(graph[held]):
+                fi, node, chain = graph[held][acq]
+                if held == acq:
+                    # only claim a single-thread deadlock when the lock
+                    # is KNOWN non-reentrant; RLock/Condition re-entry is
+                    # legal, unknown wrappers get the benefit of doubt
+                    if index.lock_kinds.get(held, "unknown") != "lock":
+                        continue
+                    yield fi.ctx.violation(
+                        self.id, node,
+                        f"non-reentrant lock {held} re-acquired while "
+                        f"already held — single-thread deadlock (witness: "
+                        f"{_chain_str(chain)})",
+                    )
+                    reported.add((held, acq))
+                elif held in levels and acq in levels and (
+                    levels[held] > levels[acq]
+                ):
+                    yield fi.ctx.violation(
+                        self.id, node,
+                        f"lock-order inversion: {acq} acquired while "
+                        f"holding {held}, but the ordering table says "
+                        f"{acq} is OUTER (acquire it first) (witness: "
+                        f"{_chain_str(chain)})",
+                    )
+                    reported.add((held, acq))
+        # cycle detection over the remaining edges
+        for cycle in self._cycles(graph):
+            edge = (cycle[0], cycle[1 % len(cycle)])
+            if edge in reported or (len(cycle) == 1):
+                continue
+            fi, node, chain = graph[cycle[0]][cycle[1 % len(cycle)]]
+            yield fi.ctx.violation(
+                self.id, node,
+                "lock-order cycle: "
+                + " -> ".join(cycle + [cycle[0]])
+                + f" — threads entering from different locks deadlock "
+                f"(witness: {_chain_str(chain)})",
+            )
+
+    @staticmethod
+    def _cycles(graph):
+        """Minimal deterministic cycle enumeration (one per SCC)."""
+        index_counter = [0]
+        stack, low, num, on_stack = [], {}, {}, set()
+        sccs = []
+
+        def strongconnect(v):
+            num[v] = low[v] = index_counter[0]
+            index_counter[0] += 1
+            stack.append(v)
+            on_stack.add(v)
+            for w in sorted(graph.get(v, {})):
+                if w == v:
+                    continue
+                if w not in num:
+                    if w in graph:
+                        strongconnect(w)
+                        low[v] = min(low[v], low[w])
+                elif w in on_stack:
+                    low[v] = min(low[v], num[w])
+            if low[v] == num[v]:
+                scc = []
+                while True:
+                    w = stack.pop()
+                    on_stack.discard(w)
+                    scc.append(w)
+                    if w == v:
+                        break
+                if len(scc) > 1:
+                    sccs.append(sorted(scc))
+
+        for v in sorted(graph):
+            if v not in num:
+                strongconnect(v)
+        # orient each SCC as a concrete cycle starting at its smallest node
+        out = []
+        for scc in sorted(sccs):
+            start = scc[0]
+            cycle, cur, seen = [start], start, {start}
+            while True:
+                nxts = [w for w in sorted(graph.get(cur, {})) if w in scc]
+                if not nxts:
+                    break
+                cur = nxts[0]
+                if cur in seen:
+                    break
+                cycle.append(cur)
+                seen.add(cur)
+            out.append(cycle)
+        return out
+
+
+class BlockingUnderLockRule:
+    """blocking-under-lock: no sleeping/syncing/socket I/O while a lock
+    is held.
+
+    A ``time.sleep``, ``os.fsync``, socket dial, subprocess, HTTP
+    request, or device synchronisation (``block_until_ready`` /
+    ``jax.device_get``) reachable — directly or through any call chain —
+    while a lock is held turns that lock into a convoy: every thread
+    needing it stalls for the full blocking latency (the serving tier's
+    p95 is exactly one such mistake away). Move the blocking work
+    outside the critical section, or suppress with a reason where the
+    blocking IS the point (the journal's fsync-under-batch-lock
+    durability contract). ``Condition.wait()`` is exempt — it releases
+    the lock while blocking.
+    """
+
+    id = "blocking-under-lock"
+
+    def check(self, index: ProjectIndex):
+        for fi in index.functions:
+            reported = set()
+            for held, kind, payload, node in fi.lock_events:
+                if kind != "call" or not held:
+                    continue
+                targets, blocking = payload
+                if blocking:
+                    key = (held[-1], blocking, node.lineno)
+                    if key in reported:
+                        continue
+                    reported.add(key)
+                    yield fi.ctx.violation(
+                        self.id, node,
+                        f"{blocking} called while {held[-1]} is held; "
+                        "move the blocking call outside the critical "
+                        "section",
+                    )
+                    continue
+                for t in targets:
+                    closure = index.blocks_transitively(t)
+                    for bname, chain in sorted(closure.items()):
+                        key = (held[-1], bname, id(t))
+                        if key in reported:
+                            continue
+                        reported.add(key)
+                        yield fi.ctx.violation(
+                            self.id, node,
+                            f"{bname} reachable while {held[-1]} is held "
+                            f"(witness: {_chain_str([fi] + chain, bname)})",
+                        )
+
+
+class EnvFlagDriftRule:
+    """env-flag-drift: every LIGHTHOUSE_TPU_*/JAX_* read must be
+    registered, and every registry entry must still have readers.
+
+    The flag registry (tools/lint/flags.json) is the single inventory of
+    behavior-changing environment switches: each entry carries a
+    description and a README anchor, and the README must actually
+    mention the flag — an undocumented flag is an unreproducible bench
+    result waiting to happen, and a registry entry with no remaining
+    readers is stale documentation that will mislead the next operator.
+    Reads are ``os.environ.get/[]/setdefault`` and ``os.getenv`` with a
+    literal name.
+    """
+
+    id = "env-flag-drift"
+
+    def _reads(self, index: ProjectIndex):
+        for ctx in index.ctxs:
+            for node in ast.walk(ctx.tree):
+                name = None
+                if isinstance(node, ast.Call):
+                    d = _dotted(node.func) or ""
+                    leaf = d.split(".")[-1]
+                    envish = (
+                        leaf in ("get", "setdefault")
+                        and len(d.split(".")) >= 2
+                        and d.split(".")[-2] == "environ"
+                    ) or leaf == "getenv"
+                    if envish and node.args and isinstance(
+                        node.args[0], ast.Constant
+                    ) and isinstance(node.args[0].value, str):
+                        name = node.args[0].value
+                elif isinstance(node, ast.Subscript):
+                    d = _dotted(node.value) or ""
+                    if d.split(".")[-1] == "environ":
+                        sl = node.slice
+                        if isinstance(sl, ast.Constant) and isinstance(
+                            sl.value, str
+                        ):
+                            name = sl.value
+                if name and FLAG_PATTERN.match(name):
+                    yield ctx, node, name
+
+    def check(self, index: ProjectIndex):
+        reg_path = index.root / FLAGS_REGISTRY
+        registry: dict[str, dict] = {}
+        reg_text = ""
+        if reg_path.exists():
+            reg_text = reg_path.read_text()
+            registry = json.loads(reg_text).get("flags", {})
+        readme = index.root / "README.md"
+        readme_text = readme.read_text() if readme.exists() else None
+        reads = sorted(
+            self._reads(index), key=lambda r: (r[0].path, r[1].lineno)
+        )
+        seen: set[str] = set()
+        for ctx, node, name in reads:
+            seen.add(name)
+            if name not in registry:
+                yield ctx.violation(
+                    self.id, node,
+                    f"env flag {name} is not in the flag registry "
+                    f"({FLAGS_REGISTRY}); register it with a description "
+                    "and README anchor",
+                )
+        for name in sorted(registry):
+            entry = registry[name] or {}
+            line = self._registry_line(reg_text, name)
+            if name not in seen:
+                yield self._registry_violation(
+                    index, line,
+                    f"stale flag registry entry {name}: no remaining "
+                    "readers in the tree; delete the entry (and its "
+                    "README row)",
+                )
+            if not entry.get("description") or not entry.get("doc"):
+                yield self._registry_violation(
+                    index, line,
+                    f"flag registry entry {name} must carry a non-empty "
+                    "'description' and a 'doc' README anchor",
+                )
+            elif readme_text is not None and (
+                entry.get("doc") not in readme_text
+                or name not in readme_text
+            ):
+                yield self._registry_violation(
+                    index, line,
+                    f"flag {name}: README.md must contain both the flag "
+                    f"name and its registry anchor ({entry.get('doc')!r})",
+                )
+
+    @staticmethod
+    def _registry_line(reg_text: str, name: str) -> int:
+        for i, line in enumerate(reg_text.splitlines(), start=1):
+            if f'"{name}"' in line:
+                return i
+        return 1
+
+    def _registry_violation(self, index: ProjectIndex, line: int, msg: str):
+        from .engine import Violation
+
+        return Violation(self.id, FLAGS_REGISTRY, line, msg)
+
+
+class MeshAxisRule:
+    """mesh-axis: collective/sharding axis names must match a declared
+    mesh axis.
+
+    ``PartitionSpec("validatrs")`` or ``psum(x, "set")`` does not fail at
+    the call site — it fails deep inside jit tracing (or silently
+    shards nothing when the spec is ignored), far from the typo. Every
+    literal axis name fed to PartitionSpec/NamedSharding, a collective
+    (psum/all_gather/axis_index/...), or an ``axis_name=`` keyword must
+    be declared: either in the authoritative MESH_AXES table or by a
+    ``Mesh(..., (axis,))`` construction somewhere in the tree. Names
+    that cannot be resolved to a literal are skipped (conservative).
+    """
+
+    id = "mesh-axis"
+
+    def __init__(self, axes: frozenset[str] = MESH_AXES):
+        self.axes = axes
+
+    def _literal_axes(self, mod: ModuleInfo, node) -> list[str]:
+        """Axis names from an expression: literals, constants, tuples."""
+        out = []
+        if isinstance(node, ast.Constant) and isinstance(node.value, str):
+            out.append(node.value)
+        elif isinstance(node, ast.Name):
+            val = mod.constants.get(node.id)
+            if val is not None:
+                out.append(val)
+        elif isinstance(node, (ast.Tuple, ast.List)):
+            for e in node.elts:
+                out.extend(self._literal_axes(mod, e))
+        return out
+
+    def _declared(self, index: ProjectIndex) -> set[str]:
+        declared = set(self.axes)
+        for mod in index.modules.values():
+            for node in ast.walk(mod.ctx.tree):
+                if not isinstance(node, ast.Call):
+                    continue
+                leaf = (_dotted(node.func) or "").split(".")[-1]
+                if leaf != "Mesh":
+                    continue
+                operands = list(node.args[1:]) + [
+                    kw.value for kw in node.keywords
+                    if kw.arg == "axis_names"
+                ]
+                for op in operands:
+                    declared.update(self._literal_axes(mod, op))
+        return declared
+
+    def check(self, index: ProjectIndex):
+        declared = self._declared(index)
+        for mod in index.modules.values():
+            aliases = {
+                name for name, imp in mod.imports.items()
+                if imp[0] == "symbol" and imp[2] in (
+                    "PartitionSpec", "NamedSharding"
+                )
+            } | {"PartitionSpec"}
+            for node in ast.walk(mod.ctx.tree):
+                if not isinstance(node, ast.Call):
+                    continue
+                leaf = (_dotted(node.func) or "").split(".")[-1]
+                used: list[tuple[str, ast.AST]] = []
+                if leaf in aliases and leaf != "NamedSharding":
+                    for a in node.args:
+                        for ax in self._literal_axes(mod, a):
+                            used.append((ax, a))
+                elif leaf in _COLLECTIVES:
+                    pos = _COLLECTIVES[leaf]
+                    if len(node.args) > pos:
+                        for ax in self._literal_axes(mod, node.args[pos]):
+                            used.append((ax, node.args[pos]))
+                for kw in node.keywords:
+                    if kw.arg == "axis_name":
+                        for ax in self._literal_axes(mod, kw.value):
+                            used.append((ax, kw.value))
+                for ax, anchor in used:
+                    if ax not in declared:
+                        yield mod.ctx.violation(
+                            self.id, anchor,
+                            f"axis name {ax!r} matches no declared mesh "
+                            f"axis {sorted(declared)}; a typo here fails "
+                            "deep inside jit tracing, not at this line",
+                        )
+
+
+class MetricOriginRule:
+    """metric-origin: every metric family originates in utils/metrics.py.
+
+    The registry-hygiene convention (PR 5) is that metric families are
+    declared once, in ``utils/metrics.py``, so the /metrics surface is
+    enumerable and collision-checked in one place. This is the
+    interprocedural version: a ``Counter``/``Gauge``/``Histogram``/
+    ``LabeledGauge`` construction — or a ``REGISTRY.counter/gauge/
+    histogram/labeled_gauge`` factory call — whose call chain does NOT
+    originate in the metrics module fails, with the witness chain from
+    the offending root. A helper that metrics.py itself drives is fine;
+    a subsystem constructing its own families at init time is ad-hoc
+    surface the hygiene test cannot see.
+    """
+
+    id = "metric-origin"
+
+    @staticmethod
+    def _is_metrics_module(path: str) -> bool:
+        return path.rsplit("/", 1)[-1] == "metrics.py"
+
+    def _construction_sites(self, index: ProjectIndex):
+        for fi in index.functions:
+            if self._is_metrics_module(fi.path):
+                continue
+            for node in ast.walk(fi.node) if fi.name != "<module>" else (
+                n for stmt in fi.node.body
+                if not isinstance(stmt, (ast.FunctionDef,
+                                         ast.AsyncFunctionDef,
+                                         ast.ClassDef))
+                for n in ast.walk(stmt)
+            ):
+                if not isinstance(node, ast.Call):
+                    continue
+                d = _dotted(node.func) or ""
+                leaf = d.split(".")[-1]
+                family = None
+                if leaf in _METRIC_CLASSES:
+                    targets = index.resolve_call(fi, node)
+                    if any(
+                        self._is_metrics_module(t.path) for t in targets
+                    ) or not targets and self._imported_from_metrics(
+                        index, fi, leaf
+                    ):
+                        family = leaf
+                elif leaf in _METRIC_FACTORIES and "." in d:
+                    family = leaf
+                if family:
+                    yield fi, node, family
+
+    @staticmethod
+    def _imported_from_metrics(index, fi, name) -> bool:
+        imp = index.by_path[fi.path].imports.get(name)
+        return bool(
+            imp and imp[0] == "symbol"
+            and imp[1].rsplit(".", 1)[-1] == "metrics"
+        )
+
+    def _offending_root(self, index: ProjectIndex, fi: FuncInfo):
+        """A caller chain ending at a non-metrics root, or None if every
+        chain originates in the metrics module."""
+        seen = {id(fi)}
+        queue = [(fi, [fi])]
+        while queue:
+            cur, chain = queue.pop(0)
+            callers = index.callers.get(id(cur), [])
+            if not callers:
+                if not self._is_metrics_module(cur.path):
+                    return chain
+                continue
+            if len(chain) >= 8:
+                return chain
+            for caller, _call in callers:
+                if self._is_metrics_module(caller.path):
+                    continue  # chains through metrics.py are sanctioned
+                if id(caller) in seen:
+                    continue
+                seen.add(id(caller))
+                queue.append((caller, chain + [caller]))
+        return None
+
+    def check(self, index: ProjectIndex):
+        for fi, node, family in self._construction_sites(index):
+            if fi.name == "<module>":
+                yield fi.ctx.violation(
+                    self.id, node,
+                    f"module-level {family} family constructed outside "
+                    "utils/metrics.py; declare it there so the /metrics "
+                    "surface stays enumerable",
+                )
+                continue
+            chain = self._offending_root(index, fi)
+            if chain is not None:
+                root = chain[-1]
+                yield fi.ctx.violation(
+                    self.id, node,
+                    f"{family} family constructed outside utils/"
+                    f"metrics.py via a call chain rooted in "
+                    f"{root.qualname} (witness: "
+                    f"{_chain_str(list(reversed(chain)))}); declare the "
+                    "family in utils/metrics.py and reference it",
+                )
+
+
+class WallclockTaintRule:
+    """wallclock-taint: wall-clock wrappers cannot launder time into
+    consensus or tracing code.
+
+    The per-file wallclock rule bans direct ``time.time()`` reads, but a
+    helper in another module — legitimately suppressed at its own
+    definition as an injection boundary — re-opens the hole if consensus
+    code calls it: the state transition again depends on when it ran.
+    This rule propagates the ban one call level: a function in
+    ``state_transition/``, ``fork_choice/``, ``chain/`` or a tracing
+    module that DIRECTLY calls a project function whose body reads the
+    wall clock is flagged, with the wrapper and its read in the witness.
+    Injected clock objects are untouched: method calls on unresolved
+    receivers (``self.slot_clock.now()``) never match — injection via a
+    parameter remains the sanctioned pattern.
+    """
+
+    id = "wallclock-taint"
+
+    _SINK_DIRS = ("state_transition/", "fork_choice/", "chain/")
+
+    def _is_sink(self, path: str) -> bool:
+        slashed = "/" + path
+        return any("/" + d in slashed for d in self._SINK_DIRS) or (
+            path.rsplit("/", 1)[-1] == "tracing.py"
+        )
+
+    def check(self, index: ProjectIndex):
+        for fi in index.functions:
+            if not self._is_sink(fi.path):
+                continue
+            reported = set()
+            for callee, call in fi.callees:
+                if not callee.wall_reads:
+                    continue
+                if callee.path == fi.path:
+                    continue  # the direct read is already flagged in-file
+                # only high-confidence resolutions: bare-name and
+                # module-attribute calls (dependency-injected objects
+                # resolve through self/attr fallbacks, which we skip)
+                d = _dotted(call.func) or ""
+                head = d.split(".")[0]
+                if head in ("self", "cls"):
+                    continue
+                if id(callee) in reported:
+                    continue
+                reported.add(id(callee))
+                read, _node = callee.wall_reads[0]
+                yield fi.ctx.violation(
+                    self.id, call,
+                    f"call into wall-clock wrapper {callee.qualname} "
+                    f"(reads {read}) from "
+                    + ("tracing" if fi.path.endswith("tracing.py")
+                       else "consensus")
+                    + " code; take the timestamp/clock as a parameter "
+                    f"(witness: {fi.qualname} -> {callee.qualname} -> "
+                    f"{read})",
+                )
+
+
+PROJECT_RULES = [
+    LockOrderRule(),
+    BlockingUnderLockRule(),
+    EnvFlagDriftRule(),
+    MeshAxisRule(),
+    MetricOriginRule(),
+    WallclockTaintRule(),
+]
+
+PROJECT_RULES_BY_ID = {r.id: r for r in PROJECT_RULES}
+
+
+def build_index(root: Path, targets=None, ctxs=None):
+    """Parse the tree (or reuse pre-parsed ctxs) into a ProjectIndex."""
+    errors: list[str] = []
+    if ctxs is None:
+        ctxs, errors = parse_contexts(root, targets)
+    return ProjectIndex(root, ctxs), errors
+
+
+def lint_project(root: Path, targets=None, rules=None, ctxs=None):
+    """Run the project rules over one whole tree.
+
+    Returns (violations, errors). Violations are anchored at concrete
+    (file, line) positions so suppressions and the baseline ratchet
+    apply exactly as for per-file rules.
+    """
+    try:
+        index, errors = build_index(root, targets, ctxs)
+    except FileNotFoundError as e:
+        return [], [str(e)]
+    rules = list(rules) if rules is not None else list(PROJECT_RULES)
+    violations = []
+    for rule in rules:
+        violations.extend(v for v in rule.check(index) if v is not None)
+    violations.sort(key=lambda v: (v.path, v.line, v.rule, v.message))
+    return violations, errors

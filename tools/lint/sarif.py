@@ -1,0 +1,88 @@
+"""SARIF 2.1.0 output for the linter.
+
+CI uploads the file so GitHub renders violations as inline PR
+annotations. Only NEW violations (post-baseline) are emitted — the
+annotations must mirror exactly what fails the job. Output is fully
+deterministic: rules and results are sorted, and no timestamps or
+absolute paths leak in (the determinism test diffs two runs byte for
+byte).
+"""
+
+from __future__ import annotations
+
+import json
+from pathlib import Path
+
+SARIF_VERSION = "2.1.0"
+SARIF_SCHEMA = (
+    "https://raw.githubusercontent.com/oasis-tcs/sarif-spec/master/"
+    "Schemata/sarif-schema-2.1.0.json"
+)
+
+
+def _first_doc_line(rule) -> str:
+    doc = (getattr(rule, "__doc__", None) or rule.id).strip()
+    return doc.splitlines()[0].rstrip(".")
+
+
+def to_sarif(violations, rules) -> dict:
+    """Build the SARIF document for one run.
+
+    `rules` is the full catalogue that ran (per-file + project), so the
+    tool metadata is complete even when a rule found nothing.
+    """
+    rule_descs = sorted(
+        {r.id: _first_doc_line(r) for r in rules}.items()
+    )
+    results = [
+        {
+            "ruleId": v.rule,
+            "level": "error",
+            "message": {"text": v.message},
+            "locations": [
+                {
+                    "physicalLocation": {
+                        "artifactLocation": {
+                            "uri": v.path,
+                            "uriBaseId": "SRCROOT",
+                        },
+                        "region": {"startLine": v.line},
+                    }
+                }
+            ],
+        }
+        for v in sorted(
+            violations, key=lambda v: (v.path, v.line, v.rule, v.message)
+        )
+    ]
+    return {
+        "$schema": SARIF_SCHEMA,
+        "version": SARIF_VERSION,
+        "runs": [
+            {
+                "tool": {
+                    "driver": {
+                        "name": "lighthouse-tpu-lint",
+                        "informationUri": (
+                            "https://github.com/sigp/lighthouse"
+                        ),
+                        "rules": [
+                            {
+                                "id": rid,
+                                "shortDescription": {"text": desc},
+                            }
+                            for rid, desc in rule_descs
+                        ],
+                    }
+                },
+                "originalUriBaseIds": {"SRCROOT": {"uri": "file:///"}},
+                "columnKind": "utf16CodeUnits",
+                "results": results,
+            }
+        ],
+    }
+
+
+def write_sarif(path: Path, violations, rules) -> None:
+    doc = to_sarif(violations, rules)
+    path.write_text(json.dumps(doc, indent=2, sort_keys=True) + "\n")

@@ -4,8 +4,8 @@ PY ?= python
 
 .PHONY: lint lint-changed lint-baseline test test-lint test-chaos \
 	test-crash test-scenario test-serving test-speculate test-kernels \
-	test-fuzz fuzz bench-serving bench-speculate bench-scale \
-	test-sharded warm-compile
+	test-fuzz fuzz test-adversary fuzz-adversary bench-serving \
+	bench-speculate bench-scale test-sharded warm-compile
 
 ## lint: per-file + interprocedural project pass (tools/lint, stdlib-only);
 ## times itself and fails over the 10s budget so it never becomes a
@@ -63,6 +63,25 @@ test-fuzz:
 fuzz:
 	JAX_PLATFORMS=cpu $(PY) -m tools.fuzz_cli --start-seed 0 \
 		--iterations 12 --budget-s 1200 --corpus-dir fuzz-findings
+
+## test-adversary: aggregation-soundness suite IN FULL — all five probe
+## families through the five-path differential rejection matrix (cpu
+## oracle, jax per-set, jax aggregated, mesh grouped, fallback
+## mid-trip), planted weaknesses, import seams (the CI adversary job;
+## tier-1 keeps the fast cpu-oracle subset)
+test-adversary:
+	JAX_PLATFORMS=cpu $(PY) -m pytest tests/test_bls_adversary.py -q \
+		-p no:cacheprovider
+	JAX_PLATFORMS=cpu $(PY) -m pytest tests/test_pubkey_table.py -q \
+		-p no:cacheprovider
+
+## fuzz-adversary: budgeted fuzz window with the adversary grammar —
+## every generated plan carries aggregation-soundness probes audited
+## against the real cpu oracle at scenario end
+fuzz-adversary:
+	JAX_PLATFORMS=cpu $(PY) -m tools.fuzz_cli --start-seed 100 \
+		--iterations 8 --budget-s 1200 --grammar adversary \
+		--corpus-dir fuzz-findings
 
 ## test-serving: serving-tier suite (cache, SSE fan-out, admission)
 test-serving:

@@ -39,7 +39,8 @@ def _fresh(pubkey_bytes: bytes) -> PublicKey:
     cache tags its OWN objects (index + table) without clobbering keys
     shared through the process-wide LRU."""
     src = _validated(bytes(pubkey_bytes))
-    return PublicKey(src.point, src.to_bytes())
+    # the shared LRU key already passed from_bytes' key_validate
+    return PublicKey(src.point, src.to_bytes(), subgroup_checked=True)
 
 
 class ValidatorPubkeyCache:

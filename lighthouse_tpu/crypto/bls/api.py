@@ -61,11 +61,44 @@ class PublicKey:
     by index instead of packing host arrays (the steady-state marshaling
     contract; reference validator_pubkey_cache.rs:10-23)."""
 
-    __slots__ = ("point", "_bytes", "_tpu_limbs", "validator_index", "table")
+    __slots__ = (
+        "point", "_bytes", "_tpu_limbs", "validator_index", "table",
+        "_subgroup_ok",
+    )
 
-    def __init__(self, point: Point, compressed: bytes | None = None):
+    def __init__(
+        self,
+        point: Point,
+        compressed: bytes | None = None,
+        *,
+        subgroup_checked: bool = False,
+    ):
         self.point = point
         self._bytes = compressed
+        # key_validate verdict cache: True when the constructor's caller
+        # already proved r-torsion membership (from_bytes, generator
+        # multiples, sums of validated keys — G1 is closed under +).
+        # Unset == unknown; subgroup_ok() decides lazily and caches.
+        if subgroup_checked:
+            self._subgroup_ok = True
+
+    def subgroup_ok(self) -> bool:
+        """blst's key_validate, cached: on the curve, in the r-torsion
+        subgroup, not the point at infinity. Keys decompressed through
+        `from_bytes` were proven at construction and answer from the
+        cache; directly-constructed points (the small-subgroup /
+        low-order-component attack surface — see crypto/bls/adversary.py)
+        pay one scalar-mul check on first use."""
+        ok = getattr(self, "_subgroup_ok", None)
+        if ok is None:
+            p = self.point
+            ok = (
+                (not p.inf)
+                and C.is_on_g1(p)
+                and C.g1_subgroup_check(p)
+            )
+            self._subgroup_ok = ok
+        return ok
 
     @classmethod
     def from_bytes(cls, data: bytes) -> "PublicKey":
@@ -77,7 +110,7 @@ class PublicKey:
             raise BlsError("public key is the point at infinity")
         if not C.g1_subgroup_check(point):
             raise BlsError("public key not in the r-torsion subgroup")
-        return cls(point, bytes(data))
+        return cls(point, bytes(data), subgroup_checked=True)
 
     def to_bytes(self) -> bytes:
         if self._bytes is None:
@@ -207,7 +240,9 @@ class SecretKey:
         return self.scalar.to_bytes(SECRET_KEY_BYTES_LEN, "big")
 
     def public_key(self) -> PublicKey:
-        return PublicKey(C.g1_generator().mul(self.scalar))
+        return PublicKey(
+            C.g1_generator().mul(self.scalar), subgroup_checked=True
+        )
 
     def sign(self, message: bytes) -> Signature:
         return Signature(hash_to_g2(bytes(message)).mul(self.scalar))
@@ -229,6 +264,32 @@ class SignatureSet:
     @classmethod
     def multiple_pubkeys(cls, signature, pubkeys, message) -> "SignatureSet":
         return cls(signature, list(pubkeys), bytes(message))
+
+
+def key_validate_enabled() -> bool:
+    """G1 key_validate coverage at the verification and import seams:
+    every pubkey that did NOT come through `PublicKey.from_bytes` gets
+    an infinity + on-curve + r-torsion check before it can influence a
+    pairing (low-order G1 components are pairing-INVISIBLE — e(T, Q) == 1
+    for any T in the cofactor subgroup — so only an explicit check
+    rejects them; crypto/bls/adversary.py constructs the probes). ON
+    unless LIGHTHOUSE_TPU_KEY_VALIDATE=0; the off switch exists for the
+    adversary suite's planted-weakness tests, which prove the probes
+    catch a stack that skips key_validate. Read per call so tests flip
+    it without reimport."""
+    return os.environ.get("LIGHTHOUSE_TPU_KEY_VALIDATE", "1") != "0"
+
+
+def pubkey_subgroup_ok(pk) -> bool:
+    """Duck-typed key_validate for one pubkey object: routes through the
+    cached `PublicKey.subgroup_ok()` when present, else checks the bare
+    point. Shared by the cpu oracle's set checks, the jax_tpu marshal
+    seam, and the device pubkey-table import."""
+    check = getattr(pk, "subgroup_ok", None)
+    if check is not None:
+        return bool(check())
+    p = pk.point
+    return (not p.inf) and C.is_on_g1(p) and C.g1_subgroup_check(p)
 
 
 # --- backend selection ------------------------------------------------------

@@ -18,6 +18,7 @@ from __future__ import annotations
 
 import random
 
+from ....utils import metrics as M
 from .. import curve_ref as C
 from .. import pairing_ref as PR
 from ..hash_to_curve_ref import hash_to_g2
@@ -31,6 +32,17 @@ def _set_checks(s) -> C.Point | None:
         return None
     if not C.g2_subgroup_check_psi(s.signature.point):
         return None
+    if _key_validate():
+        # G1-side key_validate (blst's analogue runs at decompression):
+        # a pubkey with a low-order cofactor component pairs EXACTLY like
+        # its r-torsion part — e(T, Q) == 1 for cofactor-order T — so the
+        # pairing product cannot reject it; only this check can. Cached
+        # per object: keys from PublicKey.from_bytes answer for free.
+        from .. import api
+
+        for pk in s.pubkeys:
+            if not api.pubkey_subgroup_ok(pk):
+                return None
     agg = None
     for pk in s.pubkeys:
         agg = pk.point if agg is None else agg + pk.point
@@ -39,16 +51,42 @@ def _set_checks(s) -> C.Point | None:
     return agg
 
 
+def _key_validate() -> bool:
+    from .. import api
+
+    return api.key_validate_enabled()
+
+
+def _draw_weights(seed, n: int, rng: random.Random | None = None) -> list[int]:
+    """Per-DISPATCH random-linear-combination weights: n 64-bit values,
+    each nonzero (blst.rs:45-57) and pairwise-distinct within the batch.
+    A zero weight voids its set's pairing contribution and a colliding
+    pair lets two forged sets cancel each other (crypto/bls/adversary.py
+    builds exactly that batch), so degenerate draws are redrawn and
+    counted on bls_weight_redraws_total. `rng` is injectable so tests can
+    force collisions deterministically."""
+    rng = rng if rng is not None else random.Random(seed)
+    out: list[int] = []
+    used: set[int] = set()
+    for _ in range(n):
+        r = rng.getrandbits(64) | 1
+        while r in used:
+            M.BLS_WEIGHT_REDRAWS.inc()
+            r = rng.getrandbits(64) | 1
+        used.add(r)
+        out.append(r)
+    return out
+
+
 def verify_signature_sets(sets, seed=None) -> bool:
-    rng = random.Random(seed)
+    weights = _draw_weights(seed, len(sets))
     group_pk: dict[bytes, C.Point] = {}
     order: list[bytes] = []
     sig_acc = None
-    for s in sets:
+    for s, r in zip(sets, weights):
         agg_pk = _set_checks(s)
         if agg_pk is None:
             return False
-        r = rng.getrandbits(64) | 1  # nonzero weight (blst.rs:45-57)
         # per-set weight FIRST, then per-message grouping: the weight is
         # drawn after the adversary commits to the set, so a forged set
         # cannot cancel an honest one inside its message group
@@ -73,6 +111,12 @@ def aggregate_verify(signature, pubkeys, messages) -> bool:
     # structural checks (lengths, empty, infinity) live in the api layer
     if not C.g2_subgroup_check_psi(signature.point):
         return False
+    if _key_validate():
+        from .. import api
+
+        for pk in pubkeys:
+            if not api.pubkey_subgroup_ok(pk):
+                return False
     pairs = [
         (pk.point, hash_to_g2(bytes(m))) for pk, m in zip(pubkeys, messages)
     ]

@@ -547,9 +547,20 @@ def _marshal_batch(sets, seed=None, groups=None, index_pack=None):
     `index_pack` an optional precomputed `prepack_indices` result (the
     pipeline computes both pre-marshal on the submit thread)."""
     # host-side structural checks (cheap; device work is all-or-nothing)
+    key_validate = _key_validate()
     for s in sets:
         if not s.pubkeys or s.signature.point.inf:
             return None
+        if key_validate:
+            # G1-side key_validate before any point reaches the device:
+            # low-order cofactor components are pairing-INVISIBLE
+            # (e(T, Q) == 1 for cofactor-order T), so the device pairing
+            # cannot reject them — the host check here is the only gate.
+            # Cached per object: chain pubkeys come through
+            # PublicKey.from_bytes and answer for free.
+            for pk in s.pubkeys:
+                if not _api().pubkey_subgroup_ok(pk):
+                    return None
 
     n = len(sets)
     k = max(len(s.pubkeys) for s in sets)
@@ -627,10 +638,7 @@ def _marshal_batch(sets, seed=None, groups=None, index_pack=None):
         pk_dev = jnp.asarray(pk)
         pk_traffic = (pk,)
 
-    rng = np.random.default_rng(seed)
-    scalars = np.zeros((n_b, 2), np.uint32)
-    scalars[:n, 0] = rng.integers(0, 1 << 32, size=n, dtype=np.uint32)
-    scalars[:n, 1] = rng.integers(0, 1 << 32, size=n, dtype=np.uint32) | 1
+    scalars = _draw_weight_scalars(seed, n, n_b)
 
     real = np.zeros((n_b,), bool)
     real[:n] = True
@@ -656,6 +664,55 @@ def _marshal_batch(sets, seed=None, groups=None, index_pack=None):
         n_messages=m,
         new_shape_key=new_shape_key,
     )
+
+
+def _api():
+    """Lazy api import (api imports backends lazily, so the cycle never
+    bites, but keeping it out of module import time is free)."""
+    from .. import api
+
+    return api
+
+
+def _key_validate() -> bool:
+    return _api().key_validate_enabled()
+
+
+def _draw_weight_scalars(seed, n: int, n_b: int, rng=None) -> np.ndarray:
+    """Per-DISPATCH random-linear-combination weights for the device
+    ladder: (n_b, 2) uint32 halves per set. The `| 1` on the second half
+    guarantees every real weight is nonzero; this guard additionally
+    redraws any 64-bit weight that COLLIDES with another in the same
+    batch (two equal weights let a forged pair cancel inside the
+    linear combination — crypto/bls/adversary.py builds exactly that
+    batch) and counts redraws on bls_weight_redraws_total. Weights are
+    drawn fresh from `seed` on every call — per dispatch, never per
+    batch shape. `rng` is injectable so tests force collisions
+    deterministically."""
+    rng = rng if rng is not None else np.random.default_rng(seed)
+    scalars = np.zeros((n_b, 2), np.uint32)
+    if n:
+        scalars[:n, 0] = rng.integers(0, 1 << 32, size=n, dtype=np.uint32)
+        scalars[:n, 1] = rng.integers(0, 1 << 32, size=n, dtype=np.uint32) | 1
+        w = scalars[:n, 0].astype(np.uint64) | (
+            scalars[:n, 1].astype(np.uint64) << np.uint64(32)
+        )
+        while True:
+            _, first = np.unique(w, return_index=True)
+            dup = np.ones(n, bool)
+            dup[first] = False
+            d = int(dup.sum())
+            if not d:
+                break
+            metrics.BLS_WEIGHT_REDRAWS.inc(d)
+            lo = rng.integers(0, 1 << 32, size=d, dtype=np.uint32)
+            hi = rng.integers(0, 1 << 32, size=d, dtype=np.uint32) | 1
+            scalars[:n][dup, 0] = lo
+            scalars[:n][dup, 1] = hi
+            w[dup] = lo.astype(np.uint64) | (
+                hi.astype(np.uint64) << np.uint64(32)
+            )
+    return scalars
 
 
 def _shard_min_sets() -> int:
@@ -887,6 +944,10 @@ def aggregate_verify(signature, pubkeys, messages) -> bool:
     XLA:CPU's executable serializer when the persistent compile cache
     tried to store it."""
     # structural checks (lengths, empty, infinity) live in the api layer
+    if _key_validate():
+        for pk in pubkeys:
+            if not _api().pubkey_subgroup_ok(pk):
+                return False
     k = len(pubkeys)
     k_b = _bucket(k)
     u = np.zeros((k_b, 2, 2, W), np.int32)
@@ -954,9 +1015,29 @@ class PubkeyTable:
 
     def import_new_pubkeys(self, pubkeys) -> None:
         """Append validated pubkeys (mirrors import_new_pubkeys,
-        validator_pubkey_cache.rs:79)."""
+        validator_pubkey_cache.rs:79). The import is the device table's
+        key_validate seam (blst runs it at decompression): every key is
+        checked on-curve / in-subgroup / not-infinity BEFORE any limb
+        row is packed, and the whole import is refused atomically on the
+        first bad key — a low-order or infinity point must never become
+        gatherable by validator index. Chain imports arrive through
+        ValidatorPubkeyCache (PublicKey.from_bytes) with the verdict
+        cached, so the steady-state cost is an attribute read per key."""
         if not pubkeys:
             return
+        pubkeys = list(pubkeys)
+        if _key_validate():
+            for i, pk in enumerate(pubkeys):
+                try:
+                    ok = _api().pubkey_subgroup_ok(pk)
+                except Exception:  # noqa: BLE001 -- malformed key object
+                    ok = False
+                if not ok:
+                    raise _api().BlsError(
+                        f"pubkey table import refused: key {i} failed "
+                        "key_validate (malformed, infinity, or outside "
+                        "the r-torsion subgroup)"
+                    )
         rows = np.stack([_pk_limbs(pk) for pk in pubkeys])
         self._host = np.concatenate([self._host, rows], axis=0)
         self._dev = None  # re-place (and re-balance shards) lazily

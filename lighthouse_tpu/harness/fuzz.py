@@ -92,6 +92,36 @@ class PlanGrammar:
     max_byz_fraction: float = 0.3
     settle_epochs: int = 4
     speculate_probability: float = 0.25
+    # serving/wire/scale riders: a drawn plan can front the real HTTP
+    # API on node 0 and/or run over the real wire transport — the same
+    # bounds-typed, fully seeded draw as every other knob
+    serving_probability: float = 0.15
+    wire_probability: float = 0.1
+    # aggregation-soundness probe families (crypto/bls/adversary.py)
+    # attached to the plan's end-of-run audit: any accepted forgery is
+    # an InvariantViolation finding, so the shrinker minimizes soundness
+    # regressions like any other safety bug
+    probe_probability: float = 0.25
+    probe_families: tuple = (
+        "rogue-key",
+        "weight-collision",
+        "subgroup",
+        "grouping-cancellation",
+        "speculation-poisoning",
+    )
+
+
+# Named grammars for the CLI (--grammar): "adversary" pins the
+# aggregation-soundness probe rider to every plan and biases toward the
+# speculation/byz surface those probes audit.
+GRAMMARS = {
+    "default": PlanGrammar(),
+    "adversary": PlanGrammar(
+        probe_probability=1.0,
+        speculate_probability=0.5,
+        phase_kinds=("calm", "storm", "byz", "partition", "withhold"),
+    ),
+}
 
 
 def _gen_phase(kind: str, i: int, rng: random.Random, g: PlanGrammar, nodes: int) -> Phase:
@@ -186,6 +216,22 @@ def generate_plan(seed: int, grammar: PlanGrammar | None = None) -> ScenarioPlan
         p.equivocate_every or p.conflicting_atts_every or p.byz is not None
         for p in phases
     )
+    # rider draws happen UNCONDITIONALLY and in a fixed order so each
+    # knob consumes the same rng stream position regardless of the
+    # others' outcomes (same seed -> same plan, knob by knob)
+    speculate = rng.random() < g.speculate_probability
+    serving = rng.random() < g.serving_probability
+    transport = "wire" if rng.random() < g.wire_probability else "memory"
+    probes: tuple = ()
+    if rng.random() < g.probe_probability:
+        probes = tuple(
+            sorted(
+                rng.sample(
+                    g.probe_families,
+                    rng.randint(1, len(g.probe_families)),
+                )
+            )
+        )
     return ScenarioPlan(
         name=f"fuzz-{seed}",
         seed=seed,
@@ -193,7 +239,10 @@ def generate_plan(seed: int, grammar: PlanGrammar | None = None) -> ScenarioPlan
         validator_count=g.validator_count,
         phases=tuple(phases),
         attach_slashers=needs_slashers,
-        speculate=rng.random() < g.speculate_probability,
+        speculate=speculate,
+        serving=serving,
+        transport=transport,
+        aggregation_probes=probes,
         slo=SLO(finality_min_epoch=1, heads_converge=True),
     )
 
@@ -276,6 +325,23 @@ def _shrink_candidates(plan: ScenarioPlan):
     if plan.node_count > 3:
         yield dataclasses.replace(plan, node_count=plan.node_count - 1)
     # 3) drop subsystem riders
+    if plan.aggregation_probes:
+        # one family at a time first (pin WHICH family regressed), then
+        # the whole probe rider
+        if len(plan.aggregation_probes) > 1:
+            for fi in range(len(plan.aggregation_probes)):
+                yield dataclasses.replace(
+                    plan,
+                    aggregation_probes=(
+                        plan.aggregation_probes[:fi]
+                        + plan.aggregation_probes[fi + 1 :]
+                    ),
+                )
+        yield dataclasses.replace(plan, aggregation_probes=())
+    if plan.serving:
+        yield dataclasses.replace(plan, serving=False)
+    if plan.transport != "memory":
+        yield dataclasses.replace(plan, transport="memory")
     if plan.speculate:
         yield dataclasses.replace(plan, speculate=False)
     # 4) per-phase knob resets + slot halving
@@ -344,6 +410,8 @@ def plan_from_dict(d: dict) -> ScenarioPlan:
             )
         phases.append(Phase(**pd))
     slo = SLO(**d.pop("slo"))
+    if "aggregation_probes" in d:
+        d["aggregation_probes"] = tuple(d["aggregation_probes"])
     return ScenarioPlan(phases=tuple(phases), slo=slo, **d)
 
 

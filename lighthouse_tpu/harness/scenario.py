@@ -135,6 +135,12 @@ class ScenarioPlan:
     # mix mid-scenario; serving SLOs (validator-lane immunity, cache
     # consistency after reorgs, SSE delivery) become end-of-run checks
     serving: bool = False
+    # aggregation-soundness probe families (crypto/bls/adversary.py) run
+    # against the REAL cpu oracle at scenario end, seeded from the plan:
+    # any accepted forgery raises InvariantViolation, so the fuzzer can
+    # carry these probes and shrink a soundness regression like any
+    # other safety finding
+    aggregation_probes: tuple = ()
 
 
 @dataclass
@@ -509,6 +515,17 @@ def _drive_plan(
                     f"{n.peer_id} speculation confirmed a Byzantine "
                     f"aggregate by lookup: {sorted(hit)[0].hex()[:12]}"
                 )
+    # aggregation-soundness probes against the REAL cpu oracle (the fake
+    # backend the simulation ran on never touches the pairing; the
+    # forgeries target the crypto itself, so they verify out-of-band,
+    # seeded from the plan for bit-identical replay)
+    if plan.aggregation_probes:
+        from ..crypto.bls import adversary
+
+        for violation in adversary.audit(
+            plan.aggregation_probes, seed=plan.seed, quick=True
+        ):
+            raise InvariantViolation(f"aggregation-soundness: {violation}")
     fsck_issues: dict[str, list[str]] = {}
     if plan.slo.fsck_clean:
         for n in sim.nodes:
@@ -1144,6 +1161,55 @@ def serving_chaos_plan(seed=0, nodes=4, validators=64) -> ScenarioPlan:
     )
 
 
+def aggregation_soundness_plan(seed=0, nodes=4, validators=64) -> ScenarioPlan:
+    """Aggregation-soundness probes under Byzantine pressure: a byz phase
+    drives equivocating aggregates through the chain (the confirmed_roots
+    audit watches the speculation seam), and at scenario end every
+    forgery family — rogue-key attribution, RLC weight collisions,
+    subgroup/small-order smuggling, grouping cancellation, speculation
+    poisoning — runs against the real cpu oracle. One accepted probe is
+    an InvariantViolation, shrinkable by the fuzzer like any safety
+    finding."""
+    spe = _spe()
+    return ScenarioPlan(
+        name="aggregation-soundness",
+        seed=seed,
+        node_count=nodes,
+        validator_count=validators,
+        attach_slashers=True,
+        speculate=True,
+        aggregation_probes=(
+            "rogue-key",
+            "weight-collision",
+            "subgroup",
+            "grouping-cancellation",
+            "speculation-poisoning",
+        ),
+        phases=(
+            Phase("baseline", slots=2 * spe),
+            Phase(
+                "byz-aggregates",
+                slots=2 * spe,
+                byz=ByzPlan(
+                    fraction=0.25,
+                    every=2,
+                    conflicting_votes=True,
+                    equivocating_aggregates=True,
+                ),
+            ),
+            Phase("recovery", slots=2 * spe),
+        ),
+        slo=SLO(
+            finality_min_epoch=3,
+            expect_attester_slashings=True,
+            observed_delay_p95_s=6.0,
+            max_retry_attempts=100,
+            max_breaker_transitions=50,
+            max_bisection_calls=100,
+        ),
+    )
+
+
 PLANS = {
     "partition": partition_plan,
     "churn": churn_plan,
@@ -1156,4 +1222,5 @@ PLANS = {
     "churn-backfill": churn_backfill_plan,
     "byzantine-vc": byzantine_vc_plan,
     "serving-chaos": serving_chaos_plan,
+    "aggregation-soundness": aggregation_soundness_plan,
 }

@@ -63,7 +63,9 @@ class PrecomputeEntry:
         for pk in member_pks:
             point = point + pk.point
         self.full_point = point
-        self.full_pk = PublicKey(point)
+        # sum of cache-validated member keys: G1 is closed under +, so
+        # the aggregate inherits key_validate without paying the check
+        self.full_pk = PublicKey(point, subgroup_checked=True)
         self.corrections: dict[tuple, PublicKey] = {}
 
     def matches(self, bits, attesting_indices) -> bool:
@@ -226,7 +228,8 @@ class CommitteePrecompute:
             return cached
         absent = [pk for pk, b in zip(entry.member_pks, bits) if not b]
         point = self._corrected_point(entry, absent)
-        pk = PublicKey(point)
+        # full - sum(absent) over validated keys stays in the subgroup
+        pk = PublicKey(point, subgroup_checked=True)
         if len(entry.corrections) < _MAX_CORRECTIONS_PER_ENTRY:
             entry.corrections[memo_key] = pk
         self.stats["corrections"] += 1

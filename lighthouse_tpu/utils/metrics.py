@@ -390,6 +390,12 @@ BLS_AGGREGATED_BATCHES = REGISTRY.counter(
     "bls_aggregated_batches_total",
     "Batches verified through the per-message mega-pairing path",
 )
+BLS_WEIGHT_REDRAWS = REGISTRY.counter(
+    "bls_weight_redraws_total",
+    "Random-linear-combination batch weights redrawn by the nonzero/"
+    "independence guard (a zero or within-batch colliding draw would let "
+    "a forged set cancel inside the combination)",
+)
 
 # -- the crash-safety metric family (store/kv.py journal, store/fsck.py) ------
 # Write-ahead journal recovery outcomes and consistency-checker results:

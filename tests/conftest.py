@@ -82,6 +82,13 @@ def pytest_configure(config):
     )
     config.addinivalue_line(
         "markers",
+        "adversary: aggregation-soundness probes (rogue-key, RLC weight "
+        "collision, subgroup/small-order, grouping cancellation, "
+        "speculation poisoning) — tier-1 runs the fast cpu-oracle subset, "
+        "the adversary CI job runs the full five-path matrix",
+    )
+    config.addinivalue_line(
+        "markers",
         "kernels: Pallas kernel parity matrix (interpret mode on CPU); "
         "the fused tower/Miller kernels compile slowly in interpret "
         "mode, so these also carry `slow` and run in the dedicated "
@@ -107,6 +114,7 @@ def pytest_collection_modifyitems(session, config, items):
         "test_bls_api",
         "test_bls_aggregation",  # compiles the mega-pairing group stage
         "test_bls_edge_matrix",
+        "test_bls_adversary",  # slow matrix compiles the staged verifier
         "test_pubkey_table",
         "test_known_vectors",
         "test_ef_vectors",

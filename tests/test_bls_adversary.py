@@ -1,0 +1,326 @@
+"""Aggregation-soundness adversary suite: the five probe families
+(rogue-key, weight-collision, subgroup/small-order, grouping-
+cancellation, speculation-poisoning) against every verification path.
+
+Tier-1 runs the fast cpu-oracle subset: one probe batch per family, the
+rogue-key feasibility demonstration, the planted-weakness teeth proofs
+(each family's paired weakness ACCEPTS its probe, so a regression that
+reintroduces the weakness is caught, not vacuously green), and the
+weight-guard / import-seam / speculation-seam unit tests. The full
+five-path differential matrix (cpu oracle, jax_tpu per-set, jax_tpu
+aggregated, mesh grouped, FallbackBackend mid-trip) compiles the staged
+device verifier and is marked slow; the dedicated adversary CI job runs
+it in full.
+"""
+
+import random
+
+import numpy as np
+import pytest
+
+from lighthouse_tpu.crypto.bls import adversary as A
+from lighthouse_tpu.crypto.bls import api, set_backend
+from lighthouse_tpu.crypto.bls.api import PublicKey, SecretKey
+from lighthouse_tpu.crypto.bls.backends import cpu as cpu_backend
+from lighthouse_tpu.crypto.bls.constants import R
+from lighthouse_tpu.utils import metrics as M
+
+pytestmark = pytest.mark.adversary
+
+
+@pytest.fixture(autouse=True)
+def _cpu_oracle_backend():
+    """Probes call backends directly; keep the ambient backend pinned to
+    the oracle so nothing routes through jax by accident in tier-1."""
+    set_backend("cpu")
+    yield
+    set_backend("jax_tpu")
+
+
+# -- probe material is deterministic ------------------------------------------
+
+
+class TestDeterminism:
+    def test_batches_are_pure_functions_of_seed(self):
+        for family, ctor in A.BATCHES.items():
+            for x, y in zip(ctor(5), ctor(5)):
+                for sx, sy in zip(x, y):
+                    assert bytes(sx.message) == bytes(sy.message), family
+                    assert (
+                        sx.signature.to_bytes() == sy.signature.to_bytes()
+                    ), family
+                    assert [p.point for p in sx.pubkeys] == [
+                        p.point for p in sy.pubkeys
+                    ], family
+
+    def test_seeds_vary_material(self):
+        a = A.weight_collision_batches(0)[0]
+        b = A.weight_collision_batches(1)[0]
+        assert a[0].signature.to_bytes() != b[0].signature.to_bytes()
+
+    def test_speculation_material_deterministic(self):
+        assert A.speculation_poison_material(3) == A.speculation_poison_material(3)
+
+    def test_adversarial_points_are_on_curve_outside_torsion(self):
+        from lighthouse_tpu.crypto.bls import curve_ref as C
+
+        p = A.non_subgroup_g1_point()
+        assert C.is_on_g1(p) and not C.g1_subgroup_check(p)
+        t = A.low_order_g1_point()
+        assert not t.inf and C.is_on_g1(t) and not C.g1_subgroup_check(t)
+        # order divides the cofactor: r*T returns to T's cyclic run, and
+        # crucially T pairs trivially (checked by the acceptance test
+        # below via the KEY_VALIDATE=0 planted weakness)
+        q = A.non_subgroup_g2_point()
+        assert C.is_on_g2(q) and not C.g2_subgroup_check(q)
+
+
+# -- tier-1 cpu-oracle rejections: one batch per family -----------------------
+
+
+class TestCpuOracleRejects:
+    def test_honest_control_accepts(self):
+        assert cpu_backend.verify_signature_sets(A.honest_sets(0), seed=11)
+
+    @pytest.mark.parametrize("family", sorted(A.BATCHES))
+    def test_first_probe_batch_rejected(self, family):
+        batch = A.BATCHES[family](0)[0]
+        assert cpu_backend.verify_signature_sets(batch, seed=11) is False, (
+            f"{family} probe accepted by the cpu oracle"
+        )
+
+    def test_speculation_family_audit_clean(self):
+        assert A.audit(("speculation-poisoning",), seed=0) == []
+
+    def test_audit_flags_unknown_family(self):
+        assert A.audit(("no-such-family",), seed=0) == [
+            "no-such-family: unknown probe family"
+        ]
+
+
+class TestRogueKey:
+    def test_feasibility_demo_accepts(self):
+        """The attack is REAL: with P_adv = Q - P_target smuggled into the
+        claimed signer set, the attacker's lone signature verifies as the
+        pair's aggregate. This is the fact the registry-bound import seam
+        (proof-of-possession at the deposit) exists to neutralize."""
+        assert cpu_backend.verify_signature_sets(
+            A.rogue_key_feasibility_sets(0), seed=11
+        )
+
+    def test_rogue_pubkey_passes_key_validate(self):
+        """key_validate canNOT stop a rogue key: it is a genuine r-torsion
+        point (difference of subgroup members). The mitigation is
+        structural, not point-local."""
+        pk = A.rogue_key_feasibility_sets(0)[0].pubkeys[1]
+        assert api.pubkey_subgroup_ok(pk)
+
+    def test_precompute_matches_guard_refuses_foreign_indices(self):
+        """The committee precompute substitutes aggregates only for the
+        bit-selected REGISTRY members: attributing a rogue aggregate to a
+        committee it doesn't match is refused before any point math."""
+        from lighthouse_tpu.speculate.precompute import PrecomputeEntry
+
+        rng = random.Random("rogue-precompute")
+        sks = [SecretKey(rng.randrange(1, R)) for _ in range(4)]
+        entry = PrecomputeEntry(
+            b"key", 3, 0, (10, 11, 12, 13), [sk.public_key() for sk in sks]
+        )
+        assert entry.matches((True,) * 4, (10, 11, 12, 13))
+        # an adversary claiming different membership under the same bits
+        assert not entry.matches((True,) * 4, (10, 11, 12, 99))
+        assert not entry.matches((True, True, True), (10, 11, 12))
+
+
+# -- planted weaknesses: every family's paired bug is CAUGHT ------------------
+
+
+class TestPlantedWeaknesses:
+    def test_equal_weights_accept_collision_pair(self):
+        batch = A.weight_collision_batches(0)[0]
+        assert A.weakened_verify_constant_weight(batch)
+
+    def test_zero_weights_accept_forged_single(self):
+        batch = A.weight_collision_batches(0)[2]
+        assert A.weakened_verify_zero_weight(batch)
+
+    def test_related_weight_ladder_accepts_related_pair(self):
+        batch = A.weight_collision_batches(0)[1]
+        assert A.weakened_verify_related_weights(batch)
+
+    def test_group_then_weight_accepts_cancellation_pair(self):
+        batch = A.grouping_cancellation_batches(0)[0]
+        assert A.weakened_verify_group_then_weight(batch, seed=0)
+
+    def test_sound_oracle_rejects_what_weaknesses_accept(self):
+        """The differential core: identical batches, identical structural
+        checks, the ONLY difference is the weight/grouping discipline."""
+        eq = A.weight_collision_batches(0)[0]
+        assert cpu_backend.verify_signature_sets(eq, seed=11) is False
+
+    def test_key_validate_off_accepts_low_order_component(self, monkeypatch):
+        """The pairing-invisibility weakness: with key_validate disabled
+        the poisoned pubkey P + T (T in the cofactor subgroup) verifies
+        IDENTICALLY to P — e(T, Q) == 1 — so only the explicit check
+        rejects it. Flag off = the pre-hardening stack."""
+        batch = A.subgroup_batches(0)[0]
+        monkeypatch.setenv("LIGHTHOUSE_TPU_KEY_VALIDATE", "0")
+        assert cpu_backend.verify_signature_sets(batch, seed=11) is True
+        monkeypatch.setenv("LIGHTHOUSE_TPU_KEY_VALIDATE", "1")
+        assert cpu_backend.verify_signature_sets(batch, seed=11) is False
+
+    def test_memo_without_byte_check_would_confirm_poison(self):
+        """Confirm-by-lookup teeth: the poisoned confirm is only refused
+        BECAUSE of the byte comparison — the lookup key itself matches,
+        so a hypothetical presence-only memo would have confirmed it."""
+        from lighthouse_tpu.speculate.scheduler import SpeculativeVerifier
+
+        mat = A.speculation_poison_material(0)
+        sv = SpeculativeVerifier(None, None)
+        key = (
+            bytes(mat["message"]),
+            tuple(mat["bits"]),
+            int(mat["slot"]),
+            int(mat["index"]),
+            mat["shuffling_key"],
+        )
+        sv._memo[key] = mat["honest_sig_bytes"]
+        assert key in sv._memo  # presence-only check WOULD pass
+        assert not sv.confirm(
+            mat["message"], mat["bits"], mat["slot"], mat["index"],
+            mat["shuffling_key"], mat["different_valid_sig_bytes"],
+        )
+        assert sv.stats["mismatches"] == 1
+
+
+# -- weight guard: nonzero, unique, per-dispatch ------------------------------
+
+
+class _FakeRandom:
+    """random.Random stand-in whose getrandbits walks a scripted list."""
+
+    def __init__(self, values):
+        self._values = list(values)
+
+    def getrandbits(self, _bits):
+        return self._values.pop(0)
+
+
+class _FakeNpRng:
+    """numpy Generator stand-in: the first lo/hi draw pair is all-zero
+    (every weight collides at 0x1_00000000... == 1 after the |1), later
+    redraw calls are honest — forcing the uniqueness guard to fire."""
+
+    def __init__(self, seed, scripted_calls=2):
+        self._real = np.random.default_rng(seed)
+        self._scripted = scripted_calls
+
+    def integers(self, low, high, size=None, dtype=None):
+        if self._scripted > 0:
+            self._scripted -= 1
+            return np.zeros(size, dtype=dtype)
+        return self._real.integers(low, high, size=size, dtype=dtype)
+
+
+class TestWeightGuard:
+    def test_cpu_weights_nonzero_unique_and_counted(self):
+        before = M.BLS_WEIGHT_REDRAWS.value
+        # scripted collision: 5, 5 (redraw), 9
+        w = cpu_backend._draw_weights(0, 2, rng=_FakeRandom([4, 4, 8]))
+        assert w == [5, 9]  # |1 forces odd => nonzero
+        assert M.BLS_WEIGHT_REDRAWS.value == before + 1
+
+    def test_cpu_weights_deterministic_per_seed(self):
+        assert cpu_backend._draw_weights(7, 8) == cpu_backend._draw_weights(7, 8)
+        assert cpu_backend._draw_weights(7, 8) != cpu_backend._draw_weights(8, 8)
+
+    def test_cpu_weights_all_odd_nonzero(self):
+        for w in cpu_backend._draw_weights(3, 64):
+            assert w != 0 and w % 2 == 1 and w < (1 << 64)
+
+    def test_jax_scalars_unique_nonzero_and_counted(self):
+        from lighthouse_tpu.crypto.bls.backends import jax_tpu
+
+        before = M.BLS_WEIGHT_REDRAWS.value
+        scalars = jax_tpu._draw_weight_scalars(
+            0, 4, 4, rng=_FakeNpRng(0)
+        )
+        w = scalars[:, 0].astype(np.uint64) | (
+            scalars[:, 1].astype(np.uint64) << np.uint64(32)
+        )
+        assert len(set(w.tolist())) == 4
+        assert all(x != 0 for x in w.tolist())
+        assert M.BLS_WEIGHT_REDRAWS.value >= before + 3
+
+    def test_jax_scalars_independent_per_dispatch(self):
+        from lighthouse_tpu.crypto.bls.backends import jax_tpu
+
+        a = jax_tpu._draw_weight_scalars(1, 6, 8)
+        b = jax_tpu._draw_weight_scalars(2, 6, 8)
+        assert a.tolist() != b.tolist()
+        # same dispatch seed reproduces exactly (bisection replay contract)
+        assert jax_tpu._draw_weight_scalars(1, 6, 8).tolist() == a.tolist()
+
+    def test_padding_rows_stay_zero(self):
+        from lighthouse_tpu.crypto.bls.backends import jax_tpu
+
+        scalars = jax_tpu._draw_weight_scalars(5, 3, 8)
+        assert scalars[3:].tolist() == [[0, 0]] * 5
+
+
+# -- import seams: key_validate at PublicKey and table boundaries -------------
+
+
+class TestImportSeams:
+    def test_from_bytes_rejects_non_subgroup(self):
+        from lighthouse_tpu.crypto.bls import curve_ref as C
+
+        with pytest.raises(api.BlsError):
+            PublicKey.from_bytes(C.g1_to_bytes(A.non_subgroup_g1_point()))
+
+    def test_non_subgroup_signature_rejected_in_batch(self):
+        batch = A.subgroup_batches(0)[4]
+        assert cpu_backend.verify_signature_sets(batch, seed=11) is False
+
+    def test_pubkey_subgroup_ok_verdict_is_cached(self):
+        pk = PublicKey(A.non_subgroup_g1_point())
+        assert not pk.subgroup_ok()
+        # cached verdict: mutate the point, verdict must not recompute
+        assert pk._subgroup_ok is False
+        assert not api.pubkey_subgroup_ok(pk)
+
+    def test_infinity_pubkey_refused(self):
+        from lighthouse_tpu.crypto.bls import curve_ref as C
+        from lighthouse_tpu.crypto.bls.fields_ref import Fp
+
+        pk = PublicKey(C.Point(Fp.zero(), Fp.zero(), True))
+        assert not api.pubkey_subgroup_ok(pk)
+
+
+# -- full differential matrix (slow: compiles the staged device verifier) -----
+
+
+@pytest.mark.slow
+class TestRejectionMatrix:
+    def test_honest_control_accepts_on_all_paths(self):
+        matrix = A.rejection_matrix(A.honest_sets(0), seed=11)
+        assert matrix == {path: True for path in A.PATHS}
+
+    @pytest.mark.parametrize("family", sorted(A.BATCHES))
+    def test_family_rejected_bit_identically_on_all_paths(self, family):
+        for bi, batch in enumerate(A.BATCHES[family](0)):
+            matrix = A.rejection_matrix(batch, seed=11 + bi)
+            assert matrix == {path: False for path in A.PATHS}, (
+                f"{family} batch {bi}: {matrix}"
+            )
+
+    def test_full_audit_clean(self):
+        assert A.audit(A.FAMILIES, seed=0) == []
+
+    def test_fallback_primary_really_failed_mid_trip(self):
+        primary = A._FailingPrimary()
+        from lighthouse_tpu.crypto.bls.backends.fallback import FallbackBackend
+
+        fb = FallbackBackend(primary=primary, fallback=cpu_backend)
+        assert fb.verify_signature_sets(A.honest_sets(0), seed=11)
+        assert primary.calls == 1

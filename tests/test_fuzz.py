@@ -18,6 +18,7 @@ import pytest
 
 from lighthouse_tpu.crypto.bls import set_backend
 from lighthouse_tpu.harness.fuzz import (
+    GRAMMARS,
     PLANTS,
     PlanGrammar,
     generate_plan,
@@ -75,6 +76,37 @@ class TestGenerator:
             )
             assert plan.attach_slashers == bool(needs), plan.name
 
+    def test_serving_wire_probe_riders_are_bounded_and_typed(self):
+        """The serving/wire/probe knobs draw from the grammar's bounds:
+        transport is one of the scenario harness's two transports, probe
+        families come only from the grammar tuple, and the draws are
+        deterministic per seed like every other knob."""
+        g = PlanGrammar()
+        for seed in range(40):
+            plan = generate_plan(seed, g)
+            assert plan == generate_plan(seed, g)
+            assert plan.transport in ("memory", "wire")
+            assert isinstance(plan.serving, bool)
+            assert isinstance(plan.aggregation_probes, tuple)
+            assert set(plan.aggregation_probes) <= set(g.probe_families)
+            assert len(set(plan.aggregation_probes)) == len(
+                plan.aggregation_probes
+            )
+
+    def test_rider_knobs_actually_vary_across_seeds(self):
+        plans = [generate_plan(s) for s in range(60)]
+        assert any(p.serving for p in plans)
+        assert any(p.transport == "wire" for p in plans)
+        assert any(p.aggregation_probes for p in plans)
+        assert any(not p.aggregation_probes for p in plans)
+
+    def test_adversary_grammar_pins_probes_to_every_plan(self):
+        g = GRAMMARS["adversary"]
+        for seed in range(10):
+            plan = generate_plan(seed, g)
+            assert plan.aggregation_probes, plan.name
+            assert set(plan.aggregation_probes) <= set(g.probe_families)
+
 
 class TestCorpusRoundTrip:
     def test_plan_dict_round_trip(self):
@@ -90,6 +122,28 @@ class TestCorpusRoundTrip:
         plan = generate_plan(4)  # has a byz phase
         wire = json.loads(json.dumps(plan_to_dict(plan)))
         assert plan_from_dict(wire) == plan
+
+    def test_round_trip_preserves_probe_rider(self):
+        """aggregation_probes arrives from JSON as a list; from_dict must
+        coerce it back to the tuple the frozen dataclass carries."""
+        import json
+
+        plan = next(
+            generate_plan(s, GRAMMARS["adversary"]) for s in range(5)
+        )
+        assert plan.aggregation_probes
+        wire = json.loads(json.dumps(plan_to_dict(plan)))
+        back = plan_from_dict(wire)
+        assert back == plan
+        assert isinstance(back.aggregation_probes, tuple)
+
+    def test_legacy_corpus_dicts_without_riders_still_load(self):
+        d = plan_to_dict(generate_plan(0))
+        for legacy_missing in ("aggregation_probes", "serving", "transport"):
+            d.pop(legacy_missing, None)
+        plan = plan_from_dict(d)
+        assert plan.aggregation_probes == ()
+        assert plan.transport == "memory"
 
 
 class TestShrinker:
@@ -135,6 +189,44 @@ class TestShrinker:
         small, reason = shrink(generate_plan(11), failing, max_attempts=400)
         assert reason == "plant[synthetic]: storm present"
         assert sum(p.slots for p in small.phases) >= 10
+
+    def test_shrink_drops_probe_rider_not_implicated(self):
+        """A finding unrelated to the probes sheds them: the minimized
+        reproducer must not carry an aggregation-soundness rider (which
+        would re-run real pairings on every corpus replay)."""
+        plan = next(
+            p
+            for p in (
+                generate_plan(s, GRAMMARS["adversary"]) for s in range(20)
+            )
+            if any(ph.equivocate_every for ph in p.phases)
+        )
+        assert plan.aggregation_probes
+        small, _ = shrink(plan, self._storm_fails, max_attempts=400)
+        assert small.aggregation_probes == ()
+        assert small.transport == "memory"
+        assert not small.serving
+
+    def test_shrink_narrows_to_single_probe_family(self):
+        """A probe-implicated finding keeps shrinking INSIDE the rider:
+        the walk drops families one at a time, pinning the regression to
+        the single family that still fires."""
+
+        def subgroup_audit_fails(plan):
+            if "subgroup" in plan.aggregation_probes:
+                return "invariant: aggregation-soundness: subgroup probe"
+            return None
+
+        plan = next(
+            p
+            for p in (
+                generate_plan(s, GRAMMARS["adversary"]) for s in range(20)
+            )
+            if "subgroup" in p.aggregation_probes
+            and len(p.aggregation_probes) > 1
+        )
+        small, _ = shrink(plan, subgroup_audit_fails, max_attempts=400)
+        assert small.aggregation_probes == ("subgroup",)
 
     def test_passing_plan_rejected(self):
         with pytest.raises(ValueError):

@@ -467,6 +467,55 @@ class TestResilienceMetrics:
         assert BLS_USING_FALLBACK.value == 0
         assert backend.active_backend_name() == "jax_tpu"
 
+    def test_bls_weight_redraw_guard_counted_and_exposed(self):
+        """The nonzero/independence weight guard: a within-batch weight
+        collision is redrawn (never silently kept — a colliding pair
+        would let a forged set cancel inside the random linear
+        combination) and each redraw increments
+        bls_weight_redraws_total on both host weight paths."""
+        from lighthouse_tpu.crypto.bls.backends import cpu, jax_tpu
+        from lighthouse_tpu.utils.metrics import (
+            BLS_WEIGHT_REDRAWS,
+            REGISTRY,
+        )
+
+        class ScriptedRng:
+            def __init__(self, values):
+                self.values = list(values)
+
+            def getrandbits(self, _bits):
+                return self.values.pop(0)
+
+        before = BLS_WEIGHT_REDRAWS.value
+        weights = cpu._draw_weights(0, 2, rng=ScriptedRng([6, 6, 8]))
+        assert weights == [7, 9]  # collision at 7 redrawn, both odd
+        assert BLS_WEIGHT_REDRAWS.value == before + 1
+
+        import numpy as np
+
+        class CollidingNpRng:
+            """First lo/hi pair all-zero (total weight collision across
+            the batch), redraws honest."""
+
+            def __init__(self):
+                self.real = np.random.default_rng(0)
+                self.scripted = 2
+
+            def integers(self, low, high, size=None, dtype=None):
+                if self.scripted > 0:
+                    self.scripted -= 1
+                    return np.zeros(size, dtype=dtype)
+                return self.real.integers(low, high, size=size, dtype=dtype)
+
+        before = BLS_WEIGHT_REDRAWS.value
+        scalars = jax_tpu._draw_weight_scalars(0, 4, 4, rng=CollidingNpRng())
+        w = scalars[:, 0].astype(np.uint64) | (
+            scalars[:, 1].astype(np.uint64) << np.uint64(32)
+        )
+        assert len(set(w.tolist())) == 4 and 0 not in w.tolist()
+        assert BLS_WEIGHT_REDRAWS.value >= before + 3
+        assert "bls_weight_redraws_total" in REGISTRY.expose()
+
     def test_endpoint_health_scores_exposed_with_labels(self):
         from lighthouse_tpu.resilience import HealthTracker
         from lighthouse_tpu.utils.metrics import ENDPOINT_HEALTH, REGISTRY

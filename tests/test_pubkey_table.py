@@ -9,6 +9,7 @@ index thereafter).
 
 from types import SimpleNamespace
 
+import numpy as np
 import pytest
 
 from lighthouse_tpu.chain.pubkey_cache import ValidatorPubkeyCache
@@ -88,6 +89,87 @@ def test_mixed_batch_falls_back_to_host_packing():
     sets.append(SignatureSet.single_pubkey(sk.sign(msg), pk, msg))
     assert jax_tpu._common_table(sets) is None
     assert jax_tpu.verify_signature_sets(sets, seed=7)
+
+
+class TestImportSeamKeyValidate:
+    """The table import is the key_validate seam (blst runs it at
+    decompression): malformed, non-subgroup, low-order, and infinity
+    pubkeys are refused ATOMICALLY — none of the import's keys become
+    gatherable — on the replicated placement and on every mesh width."""
+
+    def _honest(self, n, start=0):
+        cache = ValidatorPubkeyCache(_registry_state(start + n))
+        return [cache.get(i) for i in range(start, start + n)]
+
+    def _bad_keys(self):
+        from lighthouse_tpu.crypto.bls import adversary as A
+        from lighthouse_tpu.crypto.bls import curve_ref as C
+        from lighthouse_tpu.crypto.bls.api import BlsError, PublicKey
+        from lighthouse_tpu.crypto.bls.fields_ref import Fp
+
+        honest = self._honest(1)[0]
+        return BlsError, {
+            "non-subgroup": PublicKey(A.non_subgroup_g1_point()),
+            "low-order-component": PublicKey(
+                honest.point + A.low_order_g1_point()
+            ),
+            "infinity": PublicKey(C.Point(Fp.zero(), Fp.zero(), True)),
+            "malformed": object(),  # no .point at all
+        }
+
+    @pytest.mark.parametrize(
+        "kind",
+        ["non-subgroup", "low-order-component", "infinity", "malformed"],
+    )
+    def test_import_refused_atomically_replicated(self, kind, monkeypatch):
+        monkeypatch.setenv("LIGHTHOUSE_TPU_SHARD_TABLE", "0")
+        BlsError, bad = self._bad_keys()
+        table = jax_tpu.PubkeyTable()
+        batch = self._honest(4) + [bad[kind]] + self._honest(2, start=4)
+        with pytest.raises(BlsError, match="key_validate"):
+            table.import_new_pubkeys(batch)
+        assert len(table) == 0  # nothing from the batch became gatherable
+        assert not table.sharded
+
+    @pytest.mark.parametrize("mesh", [1, 2, 4])
+    def test_import_refused_on_every_mesh_width(self, mesh, monkeypatch):
+        import jax
+
+        from lighthouse_tpu.parallel import verify_sharded as vs
+
+        monkeypatch.setattr(
+            vs, "pow2_device_prefix",
+            lambda devices=None: list(jax.devices())[:mesh],
+        )
+        BlsError, bad = self._bad_keys()
+        table = jax_tpu.PubkeyTable()
+        # enough rows that the mesh-width placements actually shard
+        table.import_new_pubkeys(self._honest(32))
+        assert table.sharded == (mesh > 1)
+        with pytest.raises(BlsError, match="key_validate"):
+            table.import_new_pubkeys([bad["low-order-component"]])
+        assert len(table) == 32
+        # the refusal left the surviving table fully functional
+        rows = np.asarray(table.gather(np.arange(3)))
+        expect = np.stack(
+            [jax_tpu._pk_limbs(pk) for pk in self._honest(3)]
+        )
+        assert (rows == expect).all()
+
+    def test_key_validate_flag_is_the_planted_weakness(self, monkeypatch):
+        """LIGHTHOUSE_TPU_KEY_VALIDATE=0 reopens the seam: a low-order
+        key imports and becomes gatherable by validator index — the
+        pre-hardening behavior the default-on gate exists to close."""
+        from lighthouse_tpu.crypto.bls import adversary as A
+        from lighthouse_tpu.crypto.bls.api import PublicKey
+
+        monkeypatch.setenv("LIGHTHOUSE_TPU_KEY_VALIDATE", "0")
+        table = jax_tpu.PubkeyTable()
+        poisoned = PublicKey(
+            self._honest(1)[0].point + A.low_order_g1_point()
+        )
+        table.import_new_pubkeys([poisoned])
+        assert len(table) == 1  # weakness demonstrated: key is resident
 
 
 def test_import_new_pubkeys_extends_table():

@@ -45,6 +45,12 @@ def main(argv=None) -> int:
     )
     ap.add_argument("--corpus-dir", default=None)
     ap.add_argument(
+        "--grammar",
+        default="default",
+        help="named PlanGrammar (harness.fuzz.GRAMMARS): 'adversary' pins "
+        "aggregation-soundness probes to every generated plan",
+    )
+    ap.add_argument(
         "--no-shrink",
         action="store_true",
         help="report raw failing plans without minimizing",
@@ -55,6 +61,8 @@ def main(argv=None) -> int:
     from lighthouse_tpu.harness import fuzz as fz
 
     set_backend("fake")  # fuzz the harness + consensus logic, not pairings
+    # (aggregation_probes riders still hit the REAL cpu oracle end-of-run)
+    grammar = fz.GRAMMARS[args.grammar]
 
     t0 = time.monotonic()
     findings = []
@@ -63,7 +71,7 @@ def main(argv=None) -> int:
         if args.budget_s is not None and time.monotonic() - t0 > args.budget_s:
             break
         seed = args.start_seed + i
-        plan = fz.generate_plan(seed)
+        plan = fz.generate_plan(seed, grammar)
         reason = fz.evaluate(plan, plant=args.plant)
         ran += 1
         if reason is None:
@@ -89,6 +97,7 @@ def main(argv=None) -> int:
                 "iterations_requested": args.iterations,
                 "elapsed_s": round(time.monotonic() - t0, 1),
                 "plant": args.plant,
+                "grammar": args.grammar,
                 "findings": [
                     {
                         "seed": seed,

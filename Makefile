@@ -5,7 +5,7 @@ PY ?= python
 .PHONY: lint lint-changed lint-baseline test test-lint test-chaos \
 	test-crash test-scenario test-serving test-speculate test-kernels \
 	test-fuzz fuzz test-adversary fuzz-adversary bench-serving \
-	bench-speculate bench-scale test-sharded warm-compile
+	bench-speculate bench-latency bench-scale test-sharded warm-compile
 
 ## lint: per-file + interprocedural project pass (tools/lint, stdlib-only);
 ## times itself and fails over the 10s budget so it never becomes a
@@ -113,6 +113,13 @@ bench-serving:
 bench-speculate:
 	BENCH_PLATFORM=cpu JAX_PLATFORMS=cpu $(PY) bench.py --speculate \
 		| tee bench-speculate.json
+
+## bench-latency: bursty-arrival per-lane time-to-verdict p50/p95
+## through the continuous-batching scheduler vs the whole-batch
+## baseline, plus the pad-waste ratio (one JSON line — the artifact)
+bench-latency:
+	BENCH_PLATFORM=cpu JAX_PLATFORMS=cpu $(PY) bench.py --latency \
+		| tee bench-latency.json
 
 ## bench-scale: 2M-validator epoch transition on the simulated 4-device
 ## mesh + sharded pubkey-table per-device bytes (one JSON line — the

@@ -719,6 +719,246 @@ def _speculate_bench_inner() -> None:
     )
 
 
+def latency_bench() -> None:
+    """`bench.py --latency`: bursty gossip arrivals through the
+    continuous-batching scheduler vs the whole-batch baseline, reporting
+    per-lane time-to-verdict p50/p95 against the replayed arrival clock
+    plus the pad-waste ratio. Same artifact contract as the main bench:
+    exactly ONE JSON line, exit 0 even on failure."""
+    try:
+        _latency_bench_inner()
+    except BaseException as exc:  # never lose the artifact
+        _emit(
+            {
+                "metric": "cont_batch_ttv_p95_speedup",
+                "value": 0.0,
+                "unit": "x",
+                "error": f"latency bench: {type(exc).__name__}: {exc}",
+            }
+        )
+
+
+def _percentile(samples: list[float], q: float) -> float:
+    xs = sorted(samples)
+    if not xs:
+        return 0.0
+    return xs[min(len(xs) - 1, int(round(q * (len(xs) - 1))))]
+
+
+def _poisson(rng, lam: float) -> int:
+    """Knuth sampler -- small lambdas only (burst sizes)."""
+    import math
+
+    limit, k, p = math.exp(-lam), 0, 1.0
+    while True:
+        p *= rng.random()
+        if p <= limit:
+            return k
+        k += 1
+
+
+def _bursty_schedule(rng, slots: int, slot_s: float, burst: float):
+    """Seeded arrival schedule: (offset_s, lane, n_sets, slot) tuples.
+    Real lanes burst right after each slot boundary (Poisson burst
+    sizes, exponentially clustered offsets -- the gossip shape); the
+    block proposal lands mid-slot; speculation trickles uniformly."""
+    schedule = []
+    for slot in range(slots):
+        t0 = slot * slot_s
+        schedule.append((t0 + 0.35 * slot_s, "block", 4, slot))
+        for lane, lam, spread in (
+            ("aggregate", burst, 0.10),
+            ("unaggregated", 2.0 * burst, 0.15),
+            ("sync", 0.5 * burst, 0.10),
+        ):
+            for _ in range(_poisson(rng, lam)):
+                off = min(rng.expovariate(1.0 / (spread * slot_s)), slot_s)
+                schedule.append(
+                    (t0 + off, lane, 1 + rng.randrange(3), slot)
+                )
+        for _ in range(2):
+            schedule.append(
+                (t0 + rng.random() * slot_s, "speculative", 1, slot)
+            )
+    schedule.sort(key=lambda a: a[0])
+    return schedule
+
+
+def _latency_bench_inner() -> None:
+    import random
+    import threading
+
+    sys.path.insert(0, HERE)
+    _force_platform()
+    from lighthouse_tpu.crypto.bls import (
+        SecretKey,
+        SignatureSet,
+        set_backend,
+    )
+    from lighthouse_tpu.crypto.bls import api as bls_api
+    from lighthouse_tpu.crypto.bls import pipeline as bls_pipeline
+    from lighthouse_tpu.crypto.bls import scheduler as bls_scheduler
+    from lighthouse_tpu.utils import metrics as M
+
+    # default: the fake backend. The bench measures QUEUEING dynamics
+    # (batch-formation wait vs merge-at-next-boundary), which are
+    # backend-agnostic; BENCH_LATENCY_BACKEND=cpu pays real pairings.
+    set_backend(os.environ.get("BENCH_LATENCY_BACKEND", "fake"))
+
+    slots = int(os.environ.get("BENCH_LATENCY_SLOTS", "8"))
+    slot_s = float(os.environ.get("BENCH_LATENCY_SLOT_MS", "150")) / 1e3
+    burst = float(os.environ.get("BENCH_LATENCY_BURST", "6"))
+    seed = int(os.environ.get("BENCH_LATENCY_SEED", "7"))
+
+    # a small pool of real signed sets, cycled across arrivals
+    pool = []
+    for i in range(16):
+        sk = SecretKey(i + 1)
+        msg = bytes([i]) * 32
+        pool.append(
+            SignatureSet.single_pubkey(sk.sign(msg), sk.public_key(), msg)
+        )
+    rng = random.Random(seed)
+    schedule = _bursty_schedule(rng, slots, slot_s, burst)
+    arrivals = [
+        (off, lane, [pool[(i + j) % len(pool)] for j in range(n)], slot)
+        for i, (off, lane, n, slot) in enumerate(schedule)
+    ]
+
+    def replay_baseline():
+        """Whole-batch dispatch: every slot's arrivals wait for the slot
+        to finish forming, then verify as ONE pipeline batch (the
+        pre-scheduler seam shape). Per-arrival verdicts recover exactly
+        as callers do: conjunction when True, per-arrival re-verify
+        when False."""
+        bls_pipeline.configure()
+        lat = {i: None for i in range(len(arrivals))}
+        verdicts = {}
+        start = time.perf_counter()
+        by_slot: dict[int, list[int]] = {}
+        for i, (off, _lane, _sets, slot) in enumerate(arrivals):
+            by_slot.setdefault(slot, []).append(i)
+        for slot in sorted(by_slot):
+            boundary = (slot + 1) * slot_s
+            now = time.perf_counter() - start
+            if now < boundary:
+                time.sleep(boundary - now)
+            merged = [s for i in by_slot[slot] for s in arrivals[i][2]]
+            ok = bls_pipeline.default_pipeline().submit(merged).result()
+            if not ok:
+                for i in by_slot[slot]:
+                    verdicts[i] = bool(
+                        bls_api.verify_signature_sets(arrivals[i][2])
+                    )
+            done = time.perf_counter() - start
+            for i in by_slot[slot]:
+                verdicts.setdefault(i, bool(ok))
+                lat[i] = done - arrivals[i][0]
+        bls_pipeline.default_pipeline().drain()
+        return lat, verdicts
+
+    def replay_cont():
+        """The same arrivals through the continuous-batching scheduler:
+        the driver submits at each arrival offset, a resolver thread
+        blocks on verdicts in arrival order -- every result() is a
+        launch boundary that merges whatever queued behind it."""
+        bls_pipeline.configure()
+        sched = bls_scheduler.configure()
+        lat = {i: None for i in range(len(arrivals))}
+        verdicts = {}
+        import queue as queue_mod
+
+        q: queue_mod.Queue = queue_mod.Queue()
+        start = time.perf_counter()
+
+        def resolver():
+            while True:
+                item = q.get()
+                if item is None:
+                    return
+                i, fut = item
+                verdicts[i] = bool(fut.result())
+                lat[i] = (time.perf_counter() - start) - arrivals[i][0]
+
+        t = threading.Thread(target=resolver, daemon=True)
+        t.start()
+        for i, (off, lane, sets, slot) in enumerate(arrivals):
+            now = time.perf_counter() - start
+            if now < off:
+                time.sleep(off - now)
+            fut = bls_api.verify_signature_sets_async(
+                sets, lane=lane, slot=slot
+            )
+            q.put((i, fut))
+        q.put(None)
+        t.join()
+        sched.drain()
+        return lat, verdicts, dict(sched.stats)
+
+    prior = os.environ.get("LIGHTHOUSE_TPU_CONT_BATCH")
+    os.environ["LIGHTHOUSE_TPU_CONT_BATCH"] = "1"
+    try:
+        # warm pass (unmeasured): compiles every shape the replay will
+        # touch, so the measured pass is steady-state
+        replay_cont()
+        misses0 = M.TPU_COMPILE_CACHE_MISSES.value
+        cont_lat, cont_verdicts, stats = replay_cont()
+        cache_misses = M.TPU_COMPILE_CACHE_MISSES.value - misses0
+    finally:
+        if prior is None:
+            os.environ.pop("LIGHTHOUSE_TPU_CONT_BATCH", None)
+        else:
+            os.environ["LIGHTHOUSE_TPU_CONT_BATCH"] = prior
+    base_lat, base_verdicts = replay_baseline()
+
+    lanes = {}
+    for lane in bls_scheduler.LANES:
+        idx = [i for i, a in enumerate(arrivals) if a[1] == lane]
+        if not idx:
+            continue
+        c = [cont_lat[i] for i in idx]
+        b = [base_lat[i] for i in idx]
+        lanes[lane] = {
+            "arrivals": len(idx),
+            "p50_ms": round(1e3 * _percentile(c, 0.50), 2),
+            "p95_ms": round(1e3 * _percentile(c, 0.95), 2),
+            "baseline_p50_ms": round(1e3 * _percentile(b, 0.50), 2),
+            "baseline_p95_ms": round(1e3 * _percentile(b, 0.95), 2),
+        }
+    real_idx = [
+        i for i, a in enumerate(arrivals) if a[1] != "speculative"
+    ]
+    cont_p95 = _percentile([cont_lat[i] for i in real_idx], 0.95)
+    base_p95 = _percentile([base_lat[i] for i in real_idx], 0.95)
+    pad, real = stats["pad_sets"], stats["real_sets"]
+    payload = {
+        "metric": "cont_batch_ttv_p95_speedup",
+        "value": round(base_p95 / cont_p95, 3) if cont_p95 else 0.0,
+        "unit": "x",
+        "seed": seed,
+        "slots": slots,
+        "slot_ms": round(1e3 * slot_s, 1),
+        "arrivals": len(arrivals),
+        "lanes": lanes,
+        "pad_waste_ratio": (
+            round(pad / (pad + real), 4) if (pad + real) else 0.0
+        ),
+        "scheduler": stats,
+        "compile_cache_misses_after_warm": cache_misses,
+        "verdicts_match_baseline": cont_verdicts == base_verdicts,
+    }
+    if cont_verdicts != base_verdicts:
+        bad = [
+            i
+            for i in cont_verdicts
+            if cont_verdicts.get(i) != base_verdicts.get(i)
+        ]
+        payload["error"] = (
+            f"verdict divergence on {len(bad)} arrivals: {bad[:8]}"
+        )
+    _emit(payload)
+
+
 def scale_bench() -> None:
     """`bench.py --scale`: million-validator state sharded over the mesh.
     Times the mesh-sharded epoch processor (per_epoch_mesh.py) over a
@@ -884,6 +1124,8 @@ def main() -> None:
         serving_bench()
     elif "--speculate" in sys.argv:
         speculate_bench()
+    elif "--latency" in sys.argv:
+        latency_bench()
     elif "--scale" in sys.argv:
         scale_bench()
     elif "--profile" in sys.argv:

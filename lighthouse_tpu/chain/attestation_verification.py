@@ -221,7 +221,11 @@ def submit_unaggregated_batch(
             get_pubkey, survivors, rejected, batch_seen,
         )
     future = (
-        verify_signature_sets_async([s for _, s, _, _ in survivors])
+        verify_signature_sets_async(
+            [s for _, s, _, _ in survivors],
+            lane="unaggregated",
+            slot=min(int(att.data.slot) for att, _, _, _ in survivors),
+        )
         if survivors
         else None
     )
@@ -404,7 +408,12 @@ def submit_aggregate_batch(
 
     future = (
         verify_signature_sets_async(
-            [s for _, sets, _ in survivors for s in sets]
+            [s for _, sets, _ in survivors for s in sets],
+            lane="aggregate",
+            slot=min(
+                int(agg.message.aggregate.data.slot)
+                for agg, _, _ in survivors
+            ),
         )
         if survivors
         else None

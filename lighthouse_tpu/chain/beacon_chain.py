@@ -48,6 +48,12 @@ class BeaconChain:
         self.slot_clock = slot_clock or ManualSlotClock(
             genesis_state.genesis_time, spec.seconds_per_slot
         )
+        # anchor the continuous-batching scheduler's per-lane verdict-
+        # delay histograms on this chain's injected clock (no-op unless
+        # LIGHTHOUSE_TPU_CONT_BATCH routes lanes through the scheduler)
+        from ..crypto.bls import scheduler as bls_scheduler
+
+        bls_scheduler.set_slot_clock(self.slot_clock)
 
         genesis_state_root = genesis_state.tree_hash_root()
         # the canonical genesis block root: header with state_root filled,
@@ -531,6 +537,11 @@ class BeaconChain:
                     self.spec,
                     strategy=strategy,
                     ctxt=ctxt,
+                    # table-tagged keys: the bulk batch gathers limb rows
+                    # from the device-resident (mesh-sharded) pubkey
+                    # table, so block import is one sharded device program
+                    get_pubkey=self.pubkey_cache.getter(state),
+                    resolve_pubkey=self.pubkey_cache.resolve,
                 )
         except BlockProcessingError as e:
             raise BlockError(str(e)) from None

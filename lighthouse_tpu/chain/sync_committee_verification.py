@@ -2,8 +2,11 @@
 contribution-and-proofs (reference
 beacon_node/beacon_chain/src/sync_committee_verification.rs:1-665), with
 the repo's batch-first shape: early checks + dedup per item, then ONE
-batched signature-set verification with per-item fallback (same structure
-as attestation_verification.py / the reference's batch.rs).
+batched ASYNC signature-set dispatch (`verify_signature_sets_async`,
+lane="sync") with bisection fallback -- the same submit/complete
+PendingBatch structure as attestation_verification.py, so the sync lane
+rides the pipeline overlap and the continuous-batching scheduler exactly
+like the attestation lanes.
 
 Also houses the naive per-subcommittee aggregation pool (the analogue of
 naive_aggregation_pool.rs for sync messages) and the contribution pool
@@ -15,7 +18,11 @@ from __future__ import annotations
 
 from dataclasses import dataclass
 
-from ..crypto.bls import AggregateSignature, Signature, verify_signature_sets
+from ..crypto.bls import (
+    AggregateSignature,
+    Signature,
+    verify_signature_sets_async,
+)
 from ..state_transition.context import ConsensusContext
 from ..state_transition.signature_sets import (
     contribution_and_proof_signature_set,
@@ -24,6 +31,7 @@ from ..state_transition.signature_sets import (
     sync_selection_proof_signature_set,
 )
 from ..types.helpers import hash32
+from .attestation_verification import PendingBatch, bisect_batch_failures
 
 
 class SyncCommitteeError(ValueError):
@@ -136,11 +144,13 @@ def _early_checks_message(chain, message, subnet_id: int):
     return subnets[subnet_id]
 
 
-def batch_verify_sync_messages(
+def submit_sync_message_batch(
     chain, items, observed_contributors, ctxt: ConsensusContext | None = None
-):
-    """[(message, subnet_id)] -> (verified: [VerifiedSyncMessage],
-    rejected: [(message, reason)]). ONE backend call for the batch."""
+) -> PendingBatch:
+    """Phase 1 of the sync-message batch: early checks, set building,
+    ONE async dispatch on the sync lane. Returns a PendingBatch whose
+    ``complete()`` yields (verified, rejected) exactly like
+    ``batch_verify_sync_messages``."""
     state = chain.head_state
     get_pubkey = chain.pubkey_cache.getter(state)
 
@@ -163,24 +173,48 @@ def batch_verify_sync_messages(
         except (SyncCommitteeError, ValueError) as e:
             rejected.append((message, str(e)))
 
-    verified = []
-    if survivors:
-        sets = [s for _, _, _, s, _ in survivors]
-        if verify_signature_sets(sets):
-            ok_items = survivors
-        else:
-            ok_items = []
-            for item in survivors:
-                if verify_signature_sets([item[3]]):
-                    ok_items.append(item)
-                else:
+    future = (
+        verify_signature_sets_async(
+            [s for _, _, _, s, _ in survivors],
+            lane="sync",
+            slot=min(int(m.slot) for m, _, _, _, _ in survivors),
+        )
+        if survivors
+        else None
+    )
+
+    def complete():
+        verified = []
+        if survivors:
+            if future.result():
+                ok_items = survivors
+            else:
+                # bisection fallback: O(k log n) backend calls isolate
+                # the k poisoned messages (was O(n) per-item re-verify)
+                ok_items, bad_items = bisect_batch_failures(
+                    survivors, lambda item: [item[3]]
+                )
+                for item in bad_items:
                     rejected.append((item[0], "invalid signature"))
-        for message, subnet_id, positions, _, key in ok_items:
-            observed_contributors.observe(*key)
-            verified.append(
-                VerifiedSyncMessage(message, subnet_id, positions)
-            )
-    return verified, rejected
+            for message, subnet_id, positions, _, key in ok_items:
+                observed_contributors.observe(*key)
+                verified.append(
+                    VerifiedSyncMessage(message, subnet_id, positions)
+                )
+        return verified, rejected
+
+    return PendingBatch(future, complete)
+
+
+def batch_verify_sync_messages(
+    chain, items, observed_contributors, ctxt: ConsensusContext | None = None
+):
+    """[(message, subnet_id)] -> (verified: [VerifiedSyncMessage],
+    rejected: [(message, reason)]). Submit + complete back-to-back (the
+    synchronous entry point)."""
+    return submit_sync_message_batch(
+        chain, items, observed_contributors, ctxt
+    ).complete()
 
 
 def _early_checks_contribution(
@@ -219,17 +253,18 @@ def _early_checks_contribution(
     return agg_key, root
 
 
-def batch_verify_contributions(
+def submit_contribution_batch(
     chain,
     signed_contributions,
     observed_aggregators,
     observed_contributions,
     ctxt: ConsensusContext | None = None,
-):
-    """[SignedContributionAndProof] -> (verified, rejected). Three sets per
-    item (selection proof, contribution-and-proof signature, aggregate
-    contribution signature -- sync_committee_verification.rs's triple),
-    all verified in ONE backend call."""
+) -> PendingBatch:
+    """Phase 1 of the contribution-and-proof batch: early checks, three
+    sets per item (selection proof, contribution-and-proof signature,
+    aggregate contribution signature --
+    sync_committee_verification.rs's triple), ONE async dispatch on the
+    sync lane."""
     state = chain.head_state
     preset = chain.preset
     get_pubkey = chain.pubkey_cache.getter(state)
@@ -268,25 +303,58 @@ def batch_verify_contributions(
         except (SyncCommitteeError, ValueError) as e:
             rejected.append((signed, str(e)))
 
-    verified = []
-    if survivors:
-        all_sets = [s for _, sets, _, _, _ in survivors for s in sets]
-        if verify_signature_sets(all_sets):
-            ok_items = survivors
-        else:
-            ok_items = []
-            for item in survivors:
-                if verify_signature_sets(item[1]):
-                    ok_items.append(item)
-                else:
+    future = (
+        verify_signature_sets_async(
+            [s for _, sets, _, _, _ in survivors for s in sets],
+            lane="sync",
+            slot=min(
+                int(signed.message.contribution.slot)
+                for signed, _, _, _, _ in survivors
+            ),
+        )
+        if survivors
+        else None
+    )
+
+    def complete():
+        verified = []
+        if survivors:
+            if future.result():
+                ok_items = survivors
+            else:
+                ok_items, bad_items = bisect_batch_failures(
+                    survivors, lambda item: item[1]
+                )
+                for item in bad_items:
                     rejected.append((item[0], "invalid signature"))
-        for signed, _, agg_key, root, count in ok_items:
-            observed_aggregators.observe(*agg_key)
-            observed_contributions.observe(
-                signed.message.contribution.slot, root
-            )
-            verified.append(VerifiedContribution(signed, count))
-    return verified, rejected
+            for signed, _, agg_key, root, count in ok_items:
+                observed_aggregators.observe(*agg_key)
+                observed_contributions.observe(
+                    signed.message.contribution.slot, root
+                )
+                verified.append(VerifiedContribution(signed, count))
+        return verified, rejected
+
+    return PendingBatch(future, complete)
+
+
+def batch_verify_contributions(
+    chain,
+    signed_contributions,
+    observed_aggregators,
+    observed_contributions,
+    ctxt: ConsensusContext | None = None,
+):
+    """[SignedContributionAndProof] -> (verified, rejected). Submit +
+    complete back-to-back (the synchronous entry point; bisection on
+    batch failure)."""
+    return submit_contribution_batch(
+        chain,
+        signed_contributions,
+        observed_aggregators,
+        observed_contributions,
+        ctxt,
+    ).complete()
 
 
 # --- pools -------------------------------------------------------------------

@@ -338,7 +338,12 @@ def verify_signature_sets(sets, seed: int | None = None) -> bool:
     return _ensure_backend().verify_signature_sets(sets, seed=seed)
 
 
-def verify_signature_sets_async(sets, seed: int | None = None):
+def verify_signature_sets_async(
+    sets,
+    seed: int | None = None,
+    lane: str | None = None,
+    slot: int | None = None,
+):
     """Pipelined batch-verify: marshal + enqueue now, answer later.
 
     Returns a ``pipeline.VerifyFuture`` whose ``result()`` yields exactly
@@ -347,7 +352,21 @@ def verify_signature_sets_async(sets, seed: int | None = None):
     for this one (JAX async dispatch); futures resolve in submit order.
     Backends without an async dispatch hook (cpu, fake, fallback) compute
     eagerly at submit -- same futures, no behavioral difference.
+
+    When the caller names its `lane` (block / aggregate / unaggregated /
+    sync / speculative) and continuous batching is enabled
+    (`LIGHTHOUSE_TPU_CONT_BATCH=1`), the batch instead lands in the
+    deadline scheduler (crypto/bls/scheduler.py): it merges with other
+    queued lanes into the next padded warm-bucket launch, and `slot`
+    anchors its per-lane time-to-verdict histogram on the slot clock.
+    The returned ``ScheduledVerify`` duck-types VerifyFuture exactly.
     """
+    from . import scheduler as bls_scheduler
+
+    if lane is not None and bls_scheduler.enabled():
+        return bls_scheduler.default_scheduler().submit(
+            sets, lane=lane, seed=seed, slot=slot
+        )
     from .pipeline import default_pipeline
 
     return default_pipeline().submit(sets, seed=seed)

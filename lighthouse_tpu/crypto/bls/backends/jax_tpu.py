@@ -536,7 +536,7 @@ def prepack_indices(sets):
     return _pack_index_batch(sets, n_b, k_b)
 
 
-def _marshal_batch(sets, seed=None, groups=None, index_pack=None):
+def _marshal_batch(sets, seed=None, groups=None, index_pack=None, pad_to=None):
     """Host-side marshalling for one batch: shape bucketing, distinct-
     message grouping, limb packing (or device-table index gather),
     weights, and -- when the batch repeats messages -- the per-message
@@ -545,7 +545,15 @@ def _marshal_batch(sets, seed=None, groups=None, index_pack=None):
     pubkeys / infinity signature -> invalid, no device work). `groups`
     is an optional precomputed `aggregation.MessageGroups` and
     `index_pack` an optional precomputed `prepack_indices` result (the
-    pipeline computes both pre-marshal on the submit thread)."""
+    pipeline computes both pre-marshal on the submit thread).
+
+    `pad_to` raises the set bucket to a WARMED capacity (the continuous-
+    batching scheduler's re-batching contract): n_b is padded up to
+    `_bucket(pad_to)` and, when the natural message bucket lands strictly
+    between the warm family's {floor, n_b} endpoints, m_b is forced to
+    n_b -- trading the mega-pairing's pair savings on that launch for a
+    shape that is guaranteed warm (padded rows are masked projective
+    infinities either way, so verdicts are unchanged)."""
     # host-side structural checks (cheap; device work is all-or-nothing)
     key_validate = _key_validate()
     for s in sets:
@@ -566,6 +574,10 @@ def _marshal_batch(sets, seed=None, groups=None, index_pack=None):
     k = max(len(s.pubkeys) for s in sets)
     n_b = _bucket(n)
     k_b = _bucket(k)
+    if pad_to:
+        n_b = max(n_b, _bucket(int(pad_to)))
+        if index_pack is not None and index_pack[0].shape != (n_b, k_b):
+            index_pack = None  # prepacked at the natural bucket; repack
 
     # Distinct-message grouping: maps each set to a row of the unique-
     # message draw tensor (hash-to-curve cost scales with distinct
@@ -579,6 +591,11 @@ def _marshal_batch(sets, seed=None, groups=None, index_pack=None):
     h_idx = np.zeros((n_b,), np.int32)
     h_idx[:n] = groups.set_message
     m_b = _bucket(m)
+    if pad_to and 4 < m_b < n_b:
+        # the warm family only enumerates m_b in {floor, n_b}: a merged
+        # launch whose distinct-message bucket lands in between takes the
+        # (warm) per-set staged shape instead of a cold aggregated grid
+        m_b = n_b
     u = np.zeros((m_b, 2, 2, W), np.int32)
     for j, msg in enumerate(groups.messages):
         u[j] = _field_draws_cached(msg)
@@ -748,17 +765,22 @@ def _count_pairs(n_sets: int, pairs: int, aggregated: bool) -> None:
         metrics.BLS_AGGREGATED_BATCHES.inc()
 
 
-def dispatch_verify_signature_sets(sets, seed=None, groups=None, index_pack=None):
+def dispatch_verify_signature_sets(
+    sets, seed=None, groups=None, index_pack=None, pad_to=None
+):
     """Async half of `verify_signature_sets`: marshal + enqueue, NO host
     sync. Returns a zero-dim device bool (materialise with `bool()`), or
     a plain python bool when a structural check or the monolith/sharded
     path already decided the batch. The pipeline (crypto/bls/pipeline.py)
     overlaps the next batch's marshalling with this batch's device work
     and passes the message `groups` and gather `index_pack` it computed
-    pre-marshal.
+    pre-marshal; `pad_to` pads the set bucket to a warmed capacity (the
+    continuous-batching scheduler's zero-JIT re-batching contract).
     """
     with tracing.span("bls_marshal", sets=len(sets)):
-        mb = _marshal_batch(sets, seed=seed, groups=groups, index_pack=index_pack)
+        mb = _marshal_batch(
+            sets, seed=seed, groups=groups, index_pack=index_pack, pad_to=pad_to
+        )
     if mb is None:
         return False
 

@@ -160,10 +160,16 @@ class VerifyPipeline:
 
     # -- submission ----------------------------------------------------------
 
-    def submit(self, sets, seed: int | None = None) -> VerifyFuture:
+    def submit(
+        self, sets, seed: int | None = None, pad_to: int | None = None
+    ) -> VerifyFuture:
         """Marshal + dispatch one batch; returns its future. Backpressure:
         at configured depth, the OLDEST in-flight batch is resolved first
-        (its device work is the most likely to have finished)."""
+        (its device work is the most likely to have finished). ``pad_to``
+        asks the backend to pad the batch's set bucket to a warmed
+        capacity (the continuous-batching scheduler's zero-JIT merge
+        contract); backends whose dispatch hook doesn't take it -- and
+        eager backends, where shapes never compile -- ignore it."""
         sets = list(sets)
 
         def produce(fut):
@@ -191,6 +197,8 @@ class VerifyPipeline:
                         # the gather path's validator-index pack also
                         # rides the submit thread (same overlap)
                         kwargs["index_pack"] = prepack(sets)
+                    if pad_to and self._accepts(dispatch, "pad_to"):
+                        kwargs["pad_to"] = pad_to
                     fut._value = dispatch(sets, seed=seed, **kwargs)
                 else:
                     fut._value = dispatch(sets, seed=seed)

@@ -36,6 +36,7 @@ Liveness/SLO properties (scenario end, windowed over the run):
 from __future__ import annotations
 
 import hashlib
+import os
 import random
 from dataclasses import dataclass, field
 
@@ -127,6 +128,13 @@ class ScenarioPlan:
     # node: aggregate verification rides the committee-aggregate cache
     # and the run asserts the reorg-invalidation + metric-sanity story
     speculate: bool = False
+    # route every verification lane through the continuous-batching
+    # scheduler (crypto/bls/scheduler.py) for the run: merged padded
+    # launches, deadline admission, speculation preemption; the report
+    # grows a "cont_batch" section and the run FAILS if a launch ever
+    # admitted speculative work while validator-lane work was queued or
+    # broke deadline order
+    cont_batch: bool = False
     # "memory" (in-process MessageBus) or "wire" (real WireBus TCP
     # sockets under a deterministic WireFabric — same plans, same
     # invariants, same bit-identical replay over actual frames)
@@ -270,10 +278,18 @@ def run_scenario(plan: ScenarioPlan) -> ScenarioResult:
     from ..crypto.bls import get_backend_name, set_backend
 
     prior_backend = get_backend_name()
+    prior_cont_batch = os.environ.get("LIGHTHOUSE_TPU_CONT_BATCH")
+    if plan.cont_batch:
+        os.environ["LIGHTHOUSE_TPU_CONT_BATCH"] = "1"
     try:
         return _run_scenario(plan)
     finally:
         set_backend(prior_backend)
+        if plan.cont_batch:
+            if prior_cont_batch is None:
+                os.environ.pop("LIGHTHOUSE_TPU_CONT_BATCH", None)
+            else:
+                os.environ["LIGHTHOUSE_TPU_CONT_BATCH"] = prior_cont_batch
 
 
 def _run_scenario(plan: ScenarioPlan) -> ScenarioResult:
@@ -282,6 +298,7 @@ def _run_scenario(plan: ScenarioPlan) -> ScenarioResult:
     from ..store.fsck import run_fsck
 
     from ..crypto.bls import pipeline as bls_pipeline
+    from ..crypto.bls import scheduler as bls_scheduler
 
     set_backend("fake")
     tracer = tracing.configure(
@@ -293,6 +310,10 @@ def _run_scenario(plan: ScenarioPlan) -> ScenarioResult:
     # span attributes, so a second run must restart the numbering or the
     # replay's trace bytes diverge
     bls_pipeline.configure()
+    # same rule for the continuous-batching scheduler: fresh entry seq
+    # numbering + empty launch log per run (the env flag is scoped by
+    # run_scenario, like the backend swap)
+    bls_scheduler.configure()
     spec = ChainSpec.interop()
     preset = MINIMAL
     needs_faults = any(
@@ -354,6 +375,25 @@ def _drive_plan(
 
     left_peers: set[str] = set()
     crash_recoveries: list[dict] = []
+    # continuous batching: a deterministic speculative-lane probe rides
+    # every slot, submitted BEFORE the slot's real traffic and resolved
+    # after it -- each real launch boundary in between must withhold it
+    # (the preemption audit the end-of-run launch-log check asserts is
+    # then exercised on every slot, not vacuously true)
+    spec_probe_sets = None
+    if plan.cont_batch:
+        from ..crypto.bls import SecretKey, SignatureSet
+        from ..crypto.bls import scheduler as bls_scheduler
+
+        probe_sk = SecretKey(0x5BEC)
+        probe_msg = b"cont-batch-speculative-probe".ljust(32, b"\x00")
+        spec_probe_sets = [
+            SignatureSet.single_pubkey(
+                probe_sk.sign(probe_msg),
+                probe_sk.public_key(),
+                probe_msg,
+            )
+        ]
     slot = 1
     for pi, phase in enumerate(plan.phases):
         prng = random.Random(plan.seed * 1000003 + pi)
@@ -414,6 +454,11 @@ def _drive_plan(
                         fault_plan.set_rates(
                             error_rate=err, delay_rate=delay
                         )
+            spec_probe = None
+            if spec_probe_sets is not None:
+                spec_probe = bls_scheduler.default_scheduler().submit(
+                    spec_probe_sets, lane="speculative", slot=slot
+                )
             sim.run_slot(
                 slot,
                 active_validators=active,
@@ -466,6 +511,12 @@ def _drive_plan(
                     )
                 reopened.range_sync()
                 sim.drain()
+            if spec_probe is not None and not spec_probe.result():
+                raise InvariantViolation(
+                    f"slot {slot}: speculative probe verdict flipped -- "
+                    "a preempted speculative batch was dropped or "
+                    "mis-settled"
+                )
             checker.check_slot(slot)
             slot += 1
         if serving is not None:
@@ -587,6 +638,34 @@ def _drive_plan(
             if getattr(n.chain, "speculation", None) is not None
         )
 
+    cont_batch = None
+    if plan.cont_batch:
+        from ..crypto.bls import scheduler as bls_scheduler
+
+        sched = bls_scheduler.default_scheduler()
+        sched.drain()
+        # machine-checked scheduler invariants from the admission audit:
+        # speculation never launches ahead of queued validator-lane work,
+        # and every launch admitted in (priority, deadline) order
+        for i, rec in enumerate(sched.launch_log):
+            if "speculative" in rec["lanes"] and rec["real_queued_before"]:
+                failures.append(
+                    f"launch {i} admitted speculation ahead of "
+                    f"{rec['real_queued_before']} queued validator-lane "
+                    "batches"
+                )
+            if list(rec["keys"]) != sorted(rec["keys"]):
+                failures.append(
+                    f"launch {i} broke deadline admission order: "
+                    f"{rec['keys']}"
+                )
+        cont_batch = dict(sched.stats)
+        padded = cont_batch["pad_sets"] + cont_batch["real_sets"]
+        cont_batch["pad_waste_ratio"] = (
+            round(cont_batch["pad_sets"] / padded, 4) if padded else 0.0
+        )
+        cont_batch["launches_logged"] = len(sched.launch_log)
+
     trace = tracer.dump_json()
     report = {
         "name": plan.name,
@@ -610,6 +689,7 @@ def _drive_plan(
         "serving": serving_report,
         "transport": plan.transport,
         "speculation": speculation,
+        "cont_batch": cont_batch,
         "slo": {
             "observed_delay_p95_s": observed_p95,
             "imported_delay_p95_s": imported_p95,
@@ -1210,6 +1290,52 @@ def aggregation_soundness_plan(seed=0, nodes=4, validators=64) -> ScenarioPlan:
     )
 
 
+def bursty_traffic_plan(seed=0, nodes=4, validators=64) -> ScenarioPlan:
+    """Bursty traffic through the continuous-batching scheduler: the
+    full gossip mix (attestations, aggregates, sync messages, blocks)
+    arrives in slot-boundary bursts while speculation keeps the device
+    busy between them, and a node crashes mid-storm. The scheduler's
+    launch audit log is machine-checked at the end of the run: no
+    launch ever admitted a speculative batch while validator-lane work
+    was queued, and every launch admitted its members in
+    (priority, deadline) order — including the launches that straddle
+    the crash. Replay must stay bit-identical with the scheduler on."""
+    spe = _spe()
+    return ScenarioPlan(
+        name="bursty-traffic",
+        seed=seed,
+        node_count=nodes,
+        validator_count=validators,
+        speculate=True,
+        cont_batch=True,
+        phases=(
+            Phase("baseline", slots=spe),
+            Phase(
+                "burst-storm",
+                slots=2 * spe,
+                equivocate_every=3,
+                conflicting_atts_every=4,
+            ),
+            Phase(
+                "burst-crash",
+                slots=2 * spe,
+                equivocate_every=3,
+                crash_node=1,
+                crash_after_ops=23,
+                crash_action="after",
+            ),
+            Phase("recovery", slots=2 * spe),
+        ),
+        slo=SLO(
+            finality_min_epoch=3,
+            observed_delay_p95_s=6.0,
+            max_retry_attempts=100,
+            max_breaker_transitions=50,
+            max_bisection_calls=100,
+        ),
+    )
+
+
 PLANS = {
     "partition": partition_plan,
     "churn": churn_plan,
@@ -1223,4 +1349,5 @@ PLANS = {
     "byzantine-vc": byzantine_vc_plan,
     "serving-chaos": serving_chaos_plan,
     "aggregation-soundness": aggregation_soundness_plan,
+    "bursty-traffic": bursty_traffic_plan,
 }

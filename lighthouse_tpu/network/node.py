@@ -32,8 +32,8 @@ from ..chain.sync_committee_verification import (
     ObservedSyncContributors,
     SyncContributionPool,
     SyncMessagePool,
-    batch_verify_contributions,
-    batch_verify_sync_messages,
+    submit_contribution_batch,
+    submit_sync_message_batch,
 )
 
 GOSSIP_PENALTY = -10
@@ -618,44 +618,55 @@ class NetworkNode:
                     att.tree_hash_root(),
                 )
 
-    def _work_sync_messages(self, items) -> None:
-        with self.pools_lock:
-            self._work_sync_messages_locked(items)
-
-    def _work_sync_messages_locked(self, items) -> None:
+    def _work_sync_messages(self, items):
+        """Same deferred shape as the attestation lanes: submit under the
+        pools lock, let the worker form the next batch while the device
+        verifies this one (the sync lane of the continuous-batching
+        scheduler when it is enabled)."""
         msgs = [(m, subnet) for m, subnet, _ in items]
         sources = {id(m): s for m, _, s in items}
-        verified, rejected = batch_verify_sync_messages(
-            self.chain, msgs, self.observed_sync_contributors
-        )
-        for v in verified:
-            self.sync_message_pool.insert(v)
-            if self.chain.validator_monitor is not None:
-                self.chain.validator_monitor.on_sync_committee_message(
-                    int(v.message.validator_index), int(v.message.slot)
-                )
-        for msg, reason in rejected:
-            if "signature" in reason:
-                self.penalize(sources.get(id(msg), ""))
-
-    def _work_sync_contributions(self, items) -> None:
         with self.pools_lock:
-            self._work_sync_contributions_locked(items)
+            pending = submit_sync_message_batch(
+                self.chain, msgs, self.observed_sync_contributors
+            )
 
-    def _work_sync_contributions_locked(self, items) -> None:
+        def complete():
+            with self.pools_lock:
+                verified, rejected = pending.complete()
+                for v in verified:
+                    self.sync_message_pool.insert(v)
+                    if self.chain.validator_monitor is not None:
+                        self.chain.validator_monitor.on_sync_committee_message(
+                            int(v.message.validator_index),
+                            int(v.message.slot),
+                        )
+                for msg, reason in rejected:
+                    if "signature" in reason:
+                        self.penalize(sources.get(id(msg), ""))
+
+        return DeferredWork(pending.done, complete)
+
+    def _work_sync_contributions(self, items):
         contributions = [c for c, _ in items]
         sources = {id(c): s for c, s in items}
-        verified, rejected = batch_verify_contributions(
-            self.chain,
-            contributions,
-            self.observed_sync_aggregators,
-            self.observed_contributions,
-        )
-        for v in verified:
-            self.sync_contribution_pool.insert(v)
-        for c, reason in rejected:
-            if "signature" in reason or "selection" in reason:
-                self.penalize(sources.get(id(c), ""))
+        with self.pools_lock:
+            pending = submit_contribution_batch(
+                self.chain,
+                contributions,
+                self.observed_sync_aggregators,
+                self.observed_contributions,
+            )
+
+        def complete():
+            with self.pools_lock:
+                verified, rejected = pending.complete()
+                for v in verified:
+                    self.sync_contribution_pool.insert(v)
+                for c, reason in rejected:
+                    if "signature" in reason or "selection" in reason:
+                        self.penalize(sources.get(id(c), ""))
+
+        return DeferredWork(pending.done, complete)
 
     # -- publish (the local node's own messages) ----------------------------
 

@@ -34,7 +34,11 @@ add latency to real work.
 
 from __future__ import annotations
 
-from ..crypto.bls import Signature, SignatureSet, verify_signature_sets
+from ..crypto.bls import (
+    Signature,
+    SignatureSet,
+    verify_signature_sets_async,
+)
 from ..types import (
     DOMAIN_BEACON_ATTESTER,
     compute_epoch_at_slot,
@@ -140,11 +144,16 @@ class SpeculativeVerifier:
             if sig_bytes is None:
                 continue
             # a REAL verification (device batch of one, precomputed
-            # aggregate pubkey): only a True verdict is ever memoized
+            # aggregate pubkey): only a True verdict is ever memoized.
+            # Routed on the SPECULATIVE lane: under continuous batching
+            # this work is preempted at any launch boundary where real
+            # arrivals are queued (it stays queued, never dropped)
             s = SignatureSet.multiple_pubkeys(
                 Signature.from_bytes(bytes(sig_bytes)), [entry.full_pk], root
             )
-            if verify_signature_sets([s]):
+            if verify_signature_sets_async(
+                [s], lane="speculative", slot=slot
+            ).result():
                 self._memo[key] = bytes(sig_bytes)
                 written += 1
                 self.stats["preverified"] += 1

@@ -6,7 +6,7 @@ internal set-axis parallelism)."""
 
 from __future__ import annotations
 
-from ..crypto.bls import verify_signature_sets
+from ..crypto.bls import verify_signature_sets_async
 from ..types.presets import Preset
 from .context import ConsensusContext
 from .signature_sets import (
@@ -133,7 +133,16 @@ class BlockSignatureVerifier:
         self.include_exits(signed_block)
         self.include_sync_aggregate(signed_block)
 
-    def verify(self) -> bool:
+    def verify(self, slot: int | None = None) -> bool:
+        """One device program for the whole block's sets. Routed on the
+        block lane: under continuous batching the sets merge with queued
+        attestation/sync traffic at the HIGHEST priority; when the chain
+        passed its pubkey-cache getter in, every set is table-tagged, so
+        the batch rides the device-table gather (and the sharded mesh at
+        mesh-eligible sizes) -- whole-block import as one sharded device
+        program."""
         if not self.sets:
             return True
-        return verify_signature_sets(self.sets)
+        return verify_signature_sets_async(
+            self.sets, lane="block", slot=slot
+        ).result()

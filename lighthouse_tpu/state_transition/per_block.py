@@ -76,10 +76,16 @@ def per_block_processing(
     strategy: BlockSignatureStrategy = BlockSignatureStrategy.VERIFY_BULK,
     ctxt: ConsensusContext | None = None,
     verified_proposer_index: int | None = None,
+    get_pubkey=None,
+    resolve_pubkey=None,
 ):
     """Mutates `state` by applying `signed_block`. Signature work follows
     `strategy`; bulk mode batches all sets into one verifier call via
-    BlockSignatureVerifier."""
+    BlockSignatureVerifier. `get_pubkey`/`resolve_pubkey` are the chain's
+    pubkey-cache hooks: passing them keeps every set table-tagged, so the
+    bulk batch gathers limb rows from the device-resident (mesh-sharded)
+    table instead of host-packing -- whole-block import as one sharded
+    device program."""
     ctxt = ctxt or ConsensusContext(preset, spec)
 
     if strategy in (
@@ -88,10 +94,13 @@ def per_block_processing(
     ):
         from .block_signature_verifier import BlockSignatureVerifier
 
-        verifier = BlockSignatureVerifier(state, preset, spec, ctxt)
+        verifier = BlockSignatureVerifier(
+            state, preset, spec, ctxt,
+            get_pubkey=get_pubkey, resolve_pubkey=resolve_pubkey,
+        )
         verifier.include_all_signatures(signed_block)
         if strategy is BlockSignatureStrategy.VERIFY_BULK:
-            if not verifier.verify():
+            if not verifier.verify(slot=int(signed_block.message.slot)):
                 raise BlockProcessingSignatureError("bulk signature check failed")
         else:
             for s in verifier.sets:

@@ -656,6 +656,80 @@ SPECULATE_TABLE_BYTES = REGISTRY.gauge(
     "Device-resident per-committee aggregate-pubkey table size in bytes "
     "(lives next to the validator pubkey table in the jax_tpu backend)",
 )
+SPECULATE_PREEMPTIONS = REGISTRY.counter(
+    "speculate_preemptions_total",
+    "Speculative batches withheld at a scheduler launch boundary because "
+    "real (validator-lane) work was queued; withheld batches stay queued "
+    "and launch at the next idle boundary, never dropped",
+)
+
+# -- continuous-batching scheduler (crypto/bls/scheduler.py): per-lane
+#    deadline queues in front of the verification pipeline ------------------
+
+BLS_SCHED_MERGES = REGISTRY.counter(
+    "bls_sched_merged_launches_total",
+    "Device launches that merged entries from more than one submission "
+    "(the continuous-batching win: arrivals ride the next launch)",
+)
+BLS_SCHED_LAUNCHES = REGISTRY.counter(
+    "bls_sched_launches_total",
+    "Device launches admitted by the scheduler (merged or singleton)",
+)
+BLS_SCHED_MERGE_FALLBACKS = REGISTRY.counter(
+    "bls_sched_merge_fallbacks_total",
+    "Merged launches that verified False and were re-verified per entry "
+    "to recover exact per-submission verdicts",
+)
+BLS_SCHED_PAD_SETS = REGISTRY.counter(
+    "bls_sched_pad_sets_total",
+    "Padding rows added to reach the nearest WARMED bucket capacity "
+    "(the padding tax, numerator)",
+)
+BLS_SCHED_REAL_SETS = REGISTRY.counter(
+    "bls_sched_real_sets_total",
+    "Real signature sets admitted through the scheduler (the padding "
+    "tax, denominator)",
+)
+BLS_SCHED_QUEUE_DEPTH = REGISTRY.labeled_gauge(
+    "bls_sched_queue_depth",
+    "Entries currently queued per lane, sampled at submit/launch",
+    label="lane",
+)
+# Per-lane slot-start -> verdict latency, on the INJECTED slot clock
+# (observe_slot_delay is the one sanctioned seat; lint rule
+# span-wallclock). One histogram per lane so /metrics stays label-free.
+BLS_SCHED_VERDICT_DELAY_BLOCK = REGISTRY.histogram(
+    "bls_sched_verdict_delay_seconds_block",
+    "Slot-start to verdict for block-proposal signature batches",
+    buckets=_SLOT_DELAY_BUCKETS,
+)
+BLS_SCHED_VERDICT_DELAY_AGGREGATE = REGISTRY.histogram(
+    "bls_sched_verdict_delay_seconds_aggregate",
+    "Slot-start to verdict for aggregate-attestation batches",
+    buckets=_SLOT_DELAY_BUCKETS,
+)
+BLS_SCHED_VERDICT_DELAY_UNAGGREGATED = REGISTRY.histogram(
+    "bls_sched_verdict_delay_seconds_unaggregated",
+    "Slot-start to verdict for unaggregated-attestation batches",
+    buckets=_SLOT_DELAY_BUCKETS,
+)
+BLS_SCHED_VERDICT_DELAY_SYNC = REGISTRY.histogram(
+    "bls_sched_verdict_delay_seconds_sync",
+    "Slot-start to verdict for sync-committee message/contribution batches",
+    buckets=_SLOT_DELAY_BUCKETS,
+)
+BLS_SCHED_VERDICT_DELAY_SPECULATIVE = REGISTRY.histogram(
+    "bls_sched_verdict_delay_seconds_speculative",
+    "Slot-start to verdict for speculative idle-time batches",
+    buckets=_SLOT_DELAY_BUCKETS,
+)
+SCHEDULER_VERDICT_DELAY = {
+    "block": BLS_SCHED_VERDICT_DELAY_BLOCK,
+    "aggregate": BLS_SCHED_VERDICT_DELAY_AGGREGATE,
+    "unaggregated": BLS_SCHED_VERDICT_DELAY_UNAGGREGATED,
+    "sync": BLS_SCHED_VERDICT_DELAY_SYNC,
+    "speculative": BLS_SCHED_VERDICT_DELAY_SPECULATIVE,
+}
 
 # -- the validator-monitor metric family (validator_monitor.rs) ---------------
 # Families live HERE (metric-origin lint rule): the monitor references

@@ -89,6 +89,14 @@ def pytest_configure(config):
     )
     config.addinivalue_line(
         "markers",
+        "cont_batch: bursty traffic through the continuous-batching "
+        "scheduler (crypto/bls/scheduler.py): launch-audit invariants "
+        "(no speculation ahead of queued validator lanes, deadline "
+        "admission order) plus bit-identical replay; CI runs these as "
+        "a dedicated step",
+    )
+    config.addinivalue_line(
+        "markers",
         "kernels: Pallas kernel parity matrix (interpret mode on CPU); "
         "the fused tower/Miller kernels compile slowly in interpret "
         "mode, so these also carry `slow` and run in the dedicated "

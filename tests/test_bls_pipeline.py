@@ -169,6 +169,109 @@ class TestVerifyPipeline:
         assert verify_signature_sets_async([bad]).result() is False
 
 
+class TestContinuousBatchScheduler:
+    """The scheduler seam in front of the pipeline: lane routing through
+    the async api, merged launches, and the merge fallback recovering
+    exact per-entry verdicts on real crypto."""
+
+    @pytest.fixture(autouse=True)
+    def _fresh_scheduler(self, monkeypatch):
+        from lighthouse_tpu.crypto.bls import scheduler as S
+
+        monkeypatch.setenv("LIGHTHOUSE_TPU_CONT_BATCH", "1")
+        S.configure()
+        yield
+        S.configure()
+
+    def test_lane_routing_is_flagged_and_lane_gated(self, monkeypatch):
+        from lighthouse_tpu.crypto.bls import scheduler as S
+
+        s = _mkset(5)
+        # lane tagged + flag on: the future is the scheduler's
+        fut = verify_signature_sets_async([s], lane="aggregate", slot=1)
+        assert isinstance(fut, S.ScheduledVerify)
+        assert fut.result() is True
+        # no lane: straight to the pipeline even with the flag on
+        assert not isinstance(
+            verify_signature_sets_async([s]), S.ScheduledVerify
+        )
+        # flag off: lane tags degrade to the plain pipeline path
+        monkeypatch.setenv("LIGHTHOUSE_TPU_CONT_BATCH", "0")
+        assert not isinstance(
+            verify_signature_sets_async([s], lane="aggregate"),
+            S.ScheduledVerify,
+        )
+
+    def test_unknown_lane_rejected(self):
+        from lighthouse_tpu.crypto.bls import scheduler as S
+
+        with pytest.raises(ValueError, match="unknown scheduler lane"):
+            S.default_scheduler().submit([_mkset(0)], lane="gossip")
+
+    def test_merged_launch_settles_every_member(self):
+        from lighthouse_tpu.crypto.bls import scheduler as S
+
+        sched = S.default_scheduler()
+        futs = [
+            sched.submit([_mkset(i)], lane="unaggregated", slot=2)
+            for i in range(5)
+        ]
+        assert all(f.result() for f in futs)
+        assert sched.stats["launches"] == 1
+        assert sched.stats["merges"] == 1
+        assert sched.stats["merge_fallbacks"] == 0
+
+    def test_merge_fallback_recovers_exact_per_entry_verdicts(self):
+        """Real crypto: a merged launch containing one invalid entry
+        verifies False as a batch; the fallback must hand every caller
+        exactly the verdict the unmerged path would have produced --
+        valid entries True, the tampered one False."""
+        from lighthouse_tpu.crypto.bls import scheduler as S
+
+        set_backend("cpu")
+        good_a, good_b = _mkset(11), _mkset(12)
+        bad = SignatureSet.single_pubkey(
+            good_a.signature, good_a.pubkeys[0], b"\x27" * 32
+        )
+        sched = S.default_scheduler()
+        fa = sched.submit([good_a], lane="aggregate", slot=3)
+        fb = sched.submit([bad], lane="unaggregated", slot=3)
+        fc = sched.submit([good_b], lane="sync", slot=3)
+        assert fa.result() is True
+        assert fb.result() is False
+        assert fc.result() is True
+        assert sched.stats["launches"] == 1
+        assert sched.stats["merge_fallbacks"] == 1
+        assert M.BLS_SCHED_MERGE_FALLBACKS.value >= 1
+
+    def test_padding_counters_track_warm_capacity(self):
+        from lighthouse_tpu.crypto.bls import scheduler as S
+
+        sched = S.default_scheduler()
+        futs = [
+            sched.submit([_mkset(i)], lane="aggregate", slot=1)
+            for i in range(5)
+        ]
+        assert all(f.result() for f in futs)
+        # 5 sets pad to the 16-capacity warm bucket
+        assert S.warm_capacity(5) == 16
+        assert sched.stats["real_sets"] == 5
+        assert sched.stats["pad_sets"] == 11
+
+    def test_drain_resolves_everything_queued(self):
+        from lighthouse_tpu.crypto.bls import scheduler as S
+
+        sched = S.default_scheduler()
+        futs = [
+            sched.submit([_mkset(i)], lane=lane, slot=1)
+            for i, lane in enumerate(("block", "speculative", "sync"))
+        ]
+        sched.drain()
+        assert all(f.done() for f in futs)
+        assert all(f.result() for f in futs)
+        assert sched.queued_depth() == 0
+
+
 class TestBisection:
     def _run(self, n, bad_idx):
         items = [SimpleNamespace(i=i, bad=(i in bad_idx)) for i in range(n)]

@@ -167,6 +167,83 @@ class TestTelemetrySurfacing:
             g_b = jax_tpu.grid_bucket(n_b) if m_b < n_b else 0
             assert g_b in (0, n_b)  # never a traffic-dependent value
 
+    def test_scheduler_churn_after_warm_scores_zero_misses(self, tmp_path):
+        """The continuous-batching zero-JIT contract: after `cli warm`
+        registers the default bucket family, SEEDED CHURN across every
+        scheduler lane -- random batch sizes, random lanes, random
+        message reuse, launches forced at random boundaries -- must
+        marshal only warm shapes: zero tpu_compile_cache_misses_total,
+        because merged launches pad to the nearest warmed grid capacity
+        (`pad_to`) instead of inventing traffic-dependent shapes. The
+        backend stub runs the REAL `_marshal_batch` (the shape-count
+        seat) and skips only the device dispatch."""
+        import random
+
+        from lighthouse_tpu.crypto.bls import SecretKey, SignatureSet
+        from lighthouse_tpu.crypto.bls import pipeline as bls_pipeline
+        from lighthouse_tpu.crypto.bls import scheduler as bls_scheduler
+        from lighthouse_tpu.crypto.bls.backends import jax_tpu
+
+        class MarshalOnlyBackend:
+            @staticmethod
+            def dispatch_verify_signature_sets(
+                sets, seed=None, groups=None, index_pack=None, pad_to=None
+            ):
+                jax_tpu._marshal_batch(
+                    sets, seed=seed, groups=groups, pad_to=pad_to
+                )
+                return True
+
+        part = str(tmp_path)
+        saved_dir = CC._ARMED_DIR
+        saved_seen = set(jax_tpu._seen_shape_buckets)
+        CC._ARMED_DIR = part
+        jax_tpu._seen_shape_buckets.clear()
+        try:
+            jax_tpu.warm_compile(runner=lambda kind, args: None)
+            # simulated fresh process: the disk registry survives, the
+            # in-process executable set does not
+            jax_tpu._seen_shape_buckets.clear()
+            pipe = bls_pipeline.configure(backend=MarshalOnlyBackend)
+            sched = bls_scheduler.configure(pipeline=pipe)
+            # marshal never verifies here, so one real signature serves
+            # every (pubkey, message) combination in the churn pool
+            sk = SecretKey(3)
+            sig = sk.sign(b"\x42" * 32)
+            pool = [
+                SignatureSet.single_pubkey(sig, sk.public_key(), bytes([m]) * 32)
+                for m in range(8)
+            ]
+            rng = random.Random(1234)
+            misses = TPU_COMPILE_CACHE_MISSES.value
+            hits = TPU_COMPILE_CACHE_HITS.value
+            futs = []
+            for step in range(60):
+                lane = bls_scheduler.LANES[
+                    rng.randrange(len(bls_scheduler.LANES))
+                ]
+                batch = [
+                    pool[rng.randrange(len(pool))]
+                    for _ in range(1 + rng.randrange(6))
+                ]
+                futs.append(sched.submit(batch, lane=lane, slot=step // 8))
+                if rng.random() < 0.3:  # random launch boundary
+                    assert futs[-1].result() is True
+            for f in futs:
+                assert f.result() is True
+            sched.drain()
+            assert sched.stats["launches"] > 0
+            assert (
+                TPU_COMPILE_CACHE_MISSES.value == misses
+            ), "churn through the scheduler compiled a new shape"
+            assert TPU_COMPILE_CACHE_HITS.value > hits
+        finally:
+            bls_scheduler.configure()
+            bls_pipeline.configure()
+            CC._ARMED_DIR = saved_dir
+            jax_tpu._seen_shape_buckets.clear()
+            jax_tpu._seen_shape_buckets.update(saved_seen)
+
     def test_cold_shape_is_a_miss_and_registers_only_after_dispatch(
         self, tmp_path
     ):

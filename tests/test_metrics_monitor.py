@@ -327,6 +327,115 @@ class TestSlotDelayAndDeviceTelemetry:
         )
 
 
+class TestContinuousBatchingScheduler:
+    """The scheduler's observable surface: preemption audit (a withheld
+    speculative batch is counted AND re-queued, never dropped), the
+    launch audit log, and the per-lane verdict-delay histograms against
+    an injected slot clock."""
+
+    @pytest.fixture()
+    def scheduler(self):
+        from lighthouse_tpu.crypto.bls import scheduler as bls_scheduler
+
+        sched = bls_scheduler.configure()
+        yield sched
+        bls_scheduler.configure()
+
+    @staticmethod
+    def _one_set():
+        from lighthouse_tpu.crypto.bls import SecretKey, SignatureSet
+
+        sk = SecretKey(9)
+        msg = b"\x33" * 32
+        return SignatureSet.single_pubkey(sk.sign(msg), sk.public_key(), msg)
+
+    def test_preempted_speculative_batch_requeued_not_dropped(
+        self, scheduler
+    ):
+        from lighthouse_tpu.utils.metrics import SPECULATE_PREEMPTIONS
+
+        s = self._one_set()
+        preempt = SPECULATE_PREEMPTIONS.value
+        spec = scheduler.submit([s], lane="speculative", slot=5)
+        real = scheduler.submit([s], lane="aggregate", slot=4)
+        # the real entry's result() is a launch boundary: speculation is
+        # queued, so it must be withheld and counted -- not launched, not
+        # dropped
+        assert real.result() is True
+        assert SPECULATE_PREEMPTIONS.value == preempt + 1
+        assert scheduler.stats["preemptions"] == 1
+        assert scheduler.queued_depth("speculative") == 1, (
+            "preempted speculative batch left the queue"
+        )
+        rec = scheduler.launch_log[0]
+        assert rec["lanes"] == ("aggregate",)
+        assert rec["speculative_withheld"] == 1
+        # the preempted batch still resolves on the next idle boundary
+        # with its full verdict -- re-queued, never dropped
+        assert spec.result() is True
+        assert scheduler.queued_depth() == 0
+        assert scheduler.launch_log[1]["lanes"] == ("speculative",)
+        assert scheduler.launch_log[1]["speculative_withheld"] == 0
+
+    def test_admission_is_deadline_ordered_across_lanes(self, scheduler):
+        s = self._one_set()
+        futs = [
+            scheduler.submit([s], lane="sync", slot=7),
+            scheduler.submit([s], lane="unaggregated", slot=9),
+            scheduler.submit([s], lane="block", slot=8),
+            scheduler.submit([s], lane="aggregate", slot=6),
+        ]
+        assert all(f.result() for f in futs)
+        rec = scheduler.launch_log[0]
+        # (priority, deadline) order: block > aggregate > unaggregated >
+        # sync, regardless of submission order
+        assert rec["lanes"] == ("block", "aggregate", "unaggregated", "sync")
+        assert list(rec["keys"]) == sorted(rec["keys"])
+        assert scheduler.stats["merges"] == 1
+
+    def test_verdict_delay_rides_the_injected_slot_clock(self):
+        from lighthouse_tpu.crypto.bls import scheduler as bls_scheduler
+        from lighthouse_tpu.utils.metrics import SCHEDULER_VERDICT_DELAY
+
+        class Clock:
+            genesis_time = 100
+            seconds_per_slot = 12
+
+            def now(self):
+                return 100 + 12 * 5 + 2.0  # 2 s into slot 5
+
+        sched = bls_scheduler.configure(slot_clock=Clock())
+        try:
+            hist = SCHEDULER_VERDICT_DELAY["unaggregated"]
+            count, total = hist.count, hist.sum
+            fut = sched.submit(
+                [self._one_set()], lane="unaggregated", slot=5
+            )
+            assert fut.result() is True
+            assert hist.count == count + 1
+            assert hist.sum - total == pytest.approx(2.0)
+        finally:
+            bls_scheduler.configure()
+
+    def test_scheduler_metric_families_exposed(self):
+        text = REGISTRY.expose()
+        for name in (
+            "bls_sched_launches_total",
+            "bls_sched_merged_launches_total",
+            "bls_sched_merge_fallbacks_total",
+            "bls_sched_pad_sets_total",
+            "bls_sched_real_sets_total",
+            "bls_sched_queue_depth",
+            "speculate_preemptions_total",
+            "bls_sched_verdict_delay_seconds_block",
+            "bls_sched_verdict_delay_seconds_aggregate",
+            "bls_sched_verdict_delay_seconds_unaggregated",
+            "bls_sched_verdict_delay_seconds_sync",
+            "bls_sched_verdict_delay_seconds_speculative",
+        ):
+            assert name in text, f"{name} missing from exposition"
+
+
 class TestChainMetricsAndMonitor:
     def test_block_import_populates_phase_timers_and_monitor(self):
         before = REGISTRY._metrics["beacon_block_processing_seconds"].count

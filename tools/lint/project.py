@@ -67,10 +67,18 @@ LOCK_ORDER: tuple[str, ...] = (
     # store-side locks
     "KeyValueStore._batch_lock",
     "NativeStore._lock",
+    # continuous-batching launch serialization: one flush admits and
+    # dispatches at a time; admission (below) nests under it
+    "ContinuousBatchScheduler._launch_lock",
     # leaf utility locks — nothing is ever acquired under these
     "ResponseCache._lock",
     "EventBroadcaster._lock",
     "Registry._lock",
+    # scheduler admission: held only to move entries between the queue
+    # and a launch; pipeline dispatch always happens OUTSIDE it
+    "ContinuousBatchScheduler._lock",
+    # per-launch settle-once guard (merge fallback runs exactly once)
+    "_Launch.lock",
 )
 
 #: Mesh axis names every `PartitionSpec`/`psum`/`all_gather` must use

@@ -5,7 +5,8 @@ PY ?= python
 .PHONY: lint lint-changed lint-baseline test test-lint test-chaos \
 	test-crash test-scenario test-serving test-speculate test-kernels \
 	test-fuzz fuzz test-adversary fuzz-adversary bench-serving \
-	bench-speculate bench-latency bench-scale test-sharded warm-compile
+	bench-speculate bench-latency bench-scale test-sharded warm-compile \
+	ledger-report
 
 ## lint: per-file + interprocedural project pass (tools/lint, stdlib-only);
 ## times itself and fails over the 10s budget so it never becomes a
@@ -120,6 +121,13 @@ bench-speculate:
 bench-latency:
 	BENCH_PLATFORM=cpu JAX_PLATFORMS=cpu $(PY) bench.py --latency \
 		| tee bench-latency.json
+
+## ledger-report: run the latency bench, then print the launch-ledger
+## occupancy / pad-waste / compile-tax table (+ per-lane p50/p95
+## time-to-verdict) from its artifact — the same renderer as
+## `cli ledger --report` and /lighthouse/ledger/report
+ledger-report: bench-latency
+	JAX_PLATFORMS=cpu $(PY) -m tools.ledger_report bench-latency.json
 
 ## bench-scale: 2M-validator epoch transition on the simulated 4-device
 ## mesh + sharded pubkey-table per-device bytes (one JSON line — the

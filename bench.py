@@ -383,6 +383,7 @@ def child() -> None:
     import random as _random
 
     from lighthouse_tpu.crypto.bls.pipeline import VerifyPipeline
+    from lighthouse_tpu.obs import ledger as launch_ledger
     from lighthouse_tpu.utils import metrics as M
     from lighthouse_tpu.utils import tracing
 
@@ -393,6 +394,7 @@ def child() -> None:
             return time.perf_counter()
 
     tracer = tracing.configure(clock=_PerfClock(), rng=_random.Random(0))
+    led = launch_ledger.configure()  # rides the same injected clock
     trace_path = os.path.join(HERE, ".bench_trace.json")
 
     pipe_batches = int(os.environ.get("BENCH_PIPELINE_BATCHES", "4"))
@@ -400,14 +402,18 @@ def child() -> None:
     t0 = time.perf_counter()
     with tracer.span("bench_pipeline", batches=pipe_batches, sets=n_sets):
         futs = [
-            pipe.submit_call(verify_device, *args)
+            pipe.submit_call(verify_device, *args, n_sets=n_sets)
             for _ in range(pipe_batches)
         ]
         pipe_ok = all(f.result() for f in futs)
     pipe_s = time.perf_counter() - t0
     try:
+        # one Perfetto document: the span "X" events plus the ledger's
+        # per-kind counter tracks (real vs padded set counts over time)
+        trace_doc = tracer.chrome_trace()
+        trace_doc["traceEvents"].extend(led.chrome_counter_events())
         with open(trace_path, "w") as f:
-            f.write(tracer.dump_json())
+            f.write(json.dumps(trace_doc, sort_keys=True))
         trace_events = tracer.status()["recorded"]
     except OSError:
         trace_path, trace_events = None, 0
@@ -485,6 +491,10 @@ def profile_child() -> None:
         verify_device_aggregated,
     )
 
+    from lighthouse_tpu.obs import ledger as launch_ledger
+
+    led = launch_ledger.configure()
+
     platform = jax.devices()[0].platform
     # n/m = 64 on both defaults; the CPU shape is sized to compile inside
     # the profile time box (the TPU shape is the BASELINE.md mainnet one)
@@ -524,6 +534,20 @@ def profile_child() -> None:
     agg_compile, agg_best = timed(
         verify_device_aggregated, _example_batch(n, k, distinct=d, agg=True)
     )
+    # one warm-kind ledger record per timed layout, keyed like cli warm
+    key_unagg = "x".join(str(v) for v in (_bucket(n), _bucket(k), _bucket(d), 0))
+    key_agg = "x".join(
+        str(v)
+        for v in (_bucket(n), _bucket(k), _bucket(d), grid_bucket(_bucket(n)))
+    )
+    launch_ledger.record(
+        "warm", bucket=key_unagg, real_sets=n, padded_sets=_bucket(n),
+        compile_seconds=unagg_compile, cache_hit=False,
+    )
+    launch_ledger.record(
+        "warm", bucket=key_agg, real_sets=n, padded_sets=_bucket(n),
+        compile_seconds=agg_compile, cache_hit=False,
+    )
     pairs_agg = _bucket(d) + 1
     _emit(
         {
@@ -559,6 +583,7 @@ def profile_child() -> None:
                     )
                 ): round(agg_compile, 2),
             },
+            "ledger": led.stats(),
         }
     )
 
@@ -798,6 +823,7 @@ def _latency_bench_inner() -> None:
     from lighthouse_tpu.crypto.bls import api as bls_api
     from lighthouse_tpu.crypto.bls import pipeline as bls_pipeline
     from lighthouse_tpu.crypto.bls import scheduler as bls_scheduler
+    from lighthouse_tpu.obs import ledger as launch_ledger
     from lighthouse_tpu.utils import metrics as M
 
     # default: the fake backend. The bench measures QUEUEING dynamics
@@ -901,9 +927,13 @@ def _latency_bench_inner() -> None:
         # warm pass (unmeasured): compiles every shape the replay will
         # touch, so the measured pass is steady-state
         replay_cont()
+        # fresh ledger so the artifact's launch accounting covers ONLY
+        # the measured pass
+        led = launch_ledger.configure()
         misses0 = M.TPU_COMPILE_CACHE_MISSES.value
         cont_lat, cont_verdicts, stats = replay_cont()
         cache_misses = M.TPU_COMPILE_CACHE_MISSES.value - misses0
+        ledger_stats = led.stats()
     finally:
         if prior is None:
             os.environ.pop("LIGHTHOUSE_TPU_CONT_BATCH", None)
@@ -944,6 +974,7 @@ def _latency_bench_inner() -> None:
             round(pad / (pad + real), 4) if (pad + real) else 0.0
         ),
         "scheduler": stats,
+        "ledger": ledger_stats,
         "compile_cache_misses_after_warm": cache_misses,
         "verdicts_match_baseline": cont_verdicts == base_verdicts,
     }

@@ -678,6 +678,97 @@ def cmd_trace(args):
     return 0
 
 
+# --- launch ledger (obs/ledger.py export seat) ------------------------------
+
+
+def cmd_ledger(args):
+    """Dump (and optionally report) the launch-ledger flight recorder:
+    from a running node's /lighthouse/ledger/dump when --url is given,
+    else from a seeded in-process demo run with continuous batching ON,
+    so the dump carries merged-launch records with lane mix, padding,
+    and preemption facts. --report prints the occupancy / pad-waste /
+    compile-tax table (the same renderer tools/ledger_report.py and the
+    HTTP report route use)."""
+    from .obs import ledger as launch_ledger
+
+    if args.url:
+        import urllib.request
+
+        with urllib.request.urlopen(
+            args.url.rstrip("/") + "/lighthouse/ledger/dump", timeout=15
+        ) as r:
+            body = r.read().decode()
+        dump = json.loads(body)  # refuse to write a non-JSON artifact
+        with open(args.out, "w") as f:
+            f.write(body)
+        if args.report:
+            stats = launch_ledger.stats_from_records(
+                dump.get("records", []), dropped=dump.get("dropped", 0)
+            )
+            print(launch_ledger.format_report(stats))
+        print(json.dumps({
+            "source": args.url,
+            "records": len(dump.get("records", [])),
+            "dropped": dump.get("dropped", 0),
+            "path": args.out,
+        }))
+        return 0
+
+    # demo mode: the `cli trace` simulator workload, run with the
+    # continuous-batching scheduler engaged -- same seed, same ledger
+    # dump, byte for byte
+    import os
+    import random
+
+    from .crypto.bls import get_backend_name, set_backend
+    from .crypto.bls import pipeline as bls_pipeline
+    from .crypto.bls import scheduler as bls_scheduler
+    from .network import Simulator
+    from .utils import tracing
+
+    preset, spec = _spec_preset(args)
+    prior_backend = get_backend_name()
+    prior_cb = os.environ.get("LIGHTHOUSE_TPU_CONT_BATCH")
+    os.environ["LIGHTHOUSE_TPU_CONT_BATCH"] = "1"
+    try:
+        tracing.configure(
+            rng=random.Random(args.seed),
+            clock=tracing.StepClock(step=1e-6),
+            capacity=65536,
+        )
+        led = launch_ledger.configure(capacity=args.capacity)
+        # fresh pipeline + scheduler: batch ids / entry seqs restart, so
+        # two demo runs with one seed dump identical bytes
+        bls_pipeline.configure()
+        bls_scheduler.configure()
+        set_backend("fake")  # the demo records scheduling, not pairings
+        sim = Simulator(2, args.validators, preset, spec)
+        for slot in range(1, args.slots + 1):
+            sim.run_slot(slot)
+        sim.drain()
+        bls_scheduler.default_scheduler().drain()
+    finally:
+        set_backend(prior_backend)
+        if prior_cb is None:
+            os.environ.pop("LIGHTHOUSE_TPU_CONT_BATCH", None)
+        else:
+            os.environ["LIGHTHOUSE_TPU_CONT_BATCH"] = prior_cb
+    with open(args.out, "w") as f:
+        f.write(led.dump_json())
+    if args.report:
+        print(led.report_text())
+    status = led.status()
+    print(json.dumps({
+        "source": "demo",
+        "slots": args.slots,
+        "records": status["recorded"],
+        "dropped": status["dropped"],
+        "kinds": status["kinds"],
+        "path": args.out,
+    }))
+    return 0
+
+
 # --- scenario harness (harness/scenario.py) ---------------------------------
 
 
@@ -1012,6 +1103,27 @@ def main(argv=None) -> int:
     trace.add_argument("--capacity", type=int, default=65536,
                        help="span ring size for the demo tracer")
     trace.set_defaults(fn=cmd_trace)
+
+    ledger = sub.add_parser(
+        "ledger",
+        help="dump/report the launch-ledger flight recorder from a node "
+             "or a seeded demo run",
+    )
+    _add_network_args(ledger)
+    ledger.add_argument("--url", default=None,
+                        help="running node base URL; fetches its "
+                             "/lighthouse/ledger/dump ring")
+    ledger.add_argument("--out", default="ledger.json")
+    ledger.add_argument("--report", action="store_true",
+                        help="print the occupancy/pad-waste/compile-tax "
+                             "table")
+    ledger.add_argument("--slots", type=int, default=4,
+                        help="demo mode: slots of simulated network")
+    ledger.add_argument("--validators", type=int, default=16)
+    ledger.add_argument("--seed", type=int, default=0)
+    ledger.add_argument("--capacity", type=int, default=4096,
+                        help="launch ring size for the demo ledger")
+    ledger.set_defaults(fn=cmd_ledger)
 
     scen = sub.add_parser(
         "scenario",

@@ -33,6 +33,7 @@ import numpy as np
 import jax
 import jax.numpy as jnp
 
+from ....obs import ledger as launch_ledger
 from ....utils import compile_cache, metrics, tracing
 from .. import aggregation as AG
 from ..tpu import curve as TC
@@ -785,6 +786,7 @@ def dispatch_verify_signature_sets(
         return False
 
     n_b = int(mb.real.shape[0])
+    pairs = n_b + 1  # per-set default; aggregated branches override
     with tracing.span("bls_dispatch", bucket=n_b):
         if _mesh_eligible(n_b):
             # Multi-chip hot path: shard the per-set axis over the device
@@ -795,9 +797,8 @@ def dispatch_verify_signature_sets(
             # runs the GROUPED body: sharded mega-batches pay ~m Miller
             # pairs instead of ~n.
             if mb.member is not None:
-                _count_pairs(
-                    mb.n_sets, int(mb.u.shape[0]) + 1, aggregated=True
-                )
+                pairs = int(mb.u.shape[0]) + 1
+                _count_pairs(mb.n_sets, pairs, aggregated=True)
                 out = _mesh_verifier().verify(
                     (
                         mb.u, mb.pk, mb.sig, mb.scalars, mb.real,
@@ -821,7 +822,8 @@ def dispatch_verify_signature_sets(
             )
         elif mb.grid_idx is not None:
             # mega-pairing: Miller-pair count rides the MESSAGE bucket
-            _count_pairs(mb.n_sets, int(mb.u.shape[0]) + 1, aggregated=True)
+            pairs = int(mb.u.shape[0]) + 1
+            _count_pairs(mb.n_sets, pairs, aggregated=True)
             out = verify_device_aggregated(
                 mb.u, mb.pk, mb.sig, mb.scalars, mb.real,
                 mb.grid_idx, mb.grid_real,
@@ -836,6 +838,15 @@ def dispatch_verify_signature_sets(
         # done (execution stays async), so the shape's executables now
         # exist and are persisted: safe to register for future processes
         compile_cache.record_shape(mb.new_shape_key)
+    launch_ledger.record(
+        "dispatch",
+        bucket=n_b,
+        real_sets=mb.n_sets,
+        padded_sets=n_b,
+        n_messages=mb.n_messages,
+        miller_pairs=pairs,
+        cache_hit=mb.new_shape_key is None,
+    )
     return out
 
 
@@ -935,6 +946,14 @@ def warm_compile(buckets=None, runner=None):
         key = (n_b, k_b, m_b, g_b)
         metrics.TPU_WARM_COMPILE_SECONDS.set(
             "x".join(str(v) for v in key), seconds
+        )
+        launch_ledger.record(
+            "warm",
+            bucket="x".join(str(v) for v in key),
+            real_sets=0,  # warm batches are all padding by construction
+            padded_sets=n_b,
+            compile_seconds=seconds,
+            cache_hit=new_key is None,
         )
         report.append(
             {"bucket": key, "seconds": seconds, "compiled": new_key is not None}

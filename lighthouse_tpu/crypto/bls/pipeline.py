@@ -47,6 +47,7 @@ from __future__ import annotations
 import inspect
 from collections import deque
 
+from ...obs import ledger as launch_ledger
 from ...utils import metrics, tracing
 from . import aggregation
 
@@ -208,18 +209,36 @@ class VerifyPipeline:
                     backend.verify_signature_sets(sets, seed=seed)
                 )
             fut._state = _DISPATCHED
+            # one launch-ledger record per dispatched batch (runs inside
+            # the pipeline_submit span, so the record cross-links to it)
+            launch_ledger.record(
+                "pipeline",
+                real_sets=len(sets),
+                padded_sets=int(pad_to) if pad_to else len(sets),
+                bucket=int(pad_to) if pad_to else None,
+                entries=1,
+            )
 
         return self._enqueue(produce)
 
-    def submit_call(self, fn, *args) -> VerifyFuture:
+    def submit_call(self, fn, *args, n_sets: int | None = None) -> VerifyFuture:
         """Low-level seat: pipeline ``fn(*args)`` as one batch, where
         ``fn`` is an async-dispatching device call over pre-marshaled
         arrays (bench.py drives the measured kernel through this, so the
-        pipeline counters cover it without re-marshalling fixtures)."""
+        pipeline counters cover it without re-marshalling fixtures).
+        ``n_sets`` labels the batch on the launch ledger; the caller
+        marshalled, so only it knows the set count."""
 
         def produce(fut):
             fut._value = fn(*args)
             fut._state = _DISPATCHED
+            if n_sets is not None:
+                launch_ledger.record(
+                    "pipeline",
+                    real_sets=int(n_sets),
+                    padded_sets=int(n_sets),
+                    entries=1,
+                )
 
         return self._enqueue(produce)
 

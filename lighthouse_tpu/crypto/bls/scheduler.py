@@ -44,6 +44,7 @@ import os
 import threading
 from collections import deque
 
+from ...obs import ledger as launch_ledger
 from ...utils import metrics, tracing
 from . import pipeline as bls_pipeline
 
@@ -109,12 +110,17 @@ class _Entry:
 
 class _Launch:
     """One admitted device program: the merged entries plus the pipeline
-    future that carries their shared batch verdict."""
+    future that carries their shared batch verdict. ``audit`` is the
+    admission record built in `_admit` -- it lands verbatim on the
+    launch ledger's "sched" record, which is how the preemption facts
+    (`speculative_withheld`, `real_queued_before`) reach every exported
+    surface instead of living only in the in-process launch_log."""
 
-    __slots__ = ("entries", "future", "ready", "settled", "lock")
+    __slots__ = ("entries", "future", "ready", "settled", "lock", "audit")
 
-    def __init__(self, entries):
+    def __init__(self, entries, audit=None):
         self.entries = entries
+        self.audit = audit or {}
         self.future = None
         # set once `future` is attached: a concurrent result() caller
         # that saw the entry admitted mid-flush parks here instead of
@@ -269,20 +275,19 @@ class ContinuousBatchScheduler:
                 metrics.SPECULATE_PREEMPTIONS.inc(len(speculative))
             if not admitted:
                 return None
-            launch = _Launch(admitted)
+            audit = {
+                "lanes": tuple(e.lane for e in admitted),
+                "keys": tuple(e.sort_key()[:2] for e in admitted),
+                "real_queued_before": len(real),
+                "speculative_withheld": (
+                    len(speculative) if real else 0
+                ),
+            }
+            launch = _Launch(admitted, audit)
             for e in admitted:
                 e.launch = launch
                 self._queued.remove(e)
-            self.launch_log.append(
-                {
-                    "lanes": tuple(e.lane for e in admitted),
-                    "keys": tuple(e.sort_key()[:2] for e in admitted),
-                    "real_queued_before": len(real),
-                    "speculative_withheld": (
-                        len(speculative) if real else 0
-                    ),
-                }
-            )
+            self.launch_log.append(audit)
             self._sample_depths()
             return launch
 
@@ -315,9 +320,32 @@ class ContinuousBatchScheduler:
         if len(entries) > 1:
             self.stats["merges"] += 1
             metrics.BLS_SCHED_MERGES.inc()
+        lane_sets: dict[str, int] = {}
+        for e in entries:
+            lane_sets[e.lane] = lane_sets.get(e.lane, 0) + len(e.sets)
         with tracing.span(
             "sched_launch", entries=len(entries), sets=n, pad=pad
         ):
+            # the merged-launch ledger record, inside the sched_launch
+            # span (cross-links) and BEFORE the pipeline submit (so the
+            # sched record precedes the pipeline record it causes)
+            launch_ledger.record(
+                "sched",
+                bucket=cap,
+                real_sets=n,
+                padded_sets=n + pad,
+                entries=len(entries),
+                lanes=launch.audit.get("lanes"),
+                lane_sets=lane_sets,
+                slot=min(
+                    (int(e.slot) for e in entries if e.slot is not None),
+                    default=None,
+                ),
+                speculative_withheld=launch.audit.get(
+                    "speculative_withheld"
+                ),
+                real_queued_before=launch.audit.get("real_queued_before"),
+            )
             launch.future = self._active_pipeline().submit(
                 merged, seed=seed, pad_to=cap
             )

@@ -155,6 +155,7 @@ class ScenarioPlan:
 class ScenarioResult:
     report: dict
     trace: str  # Chrome trace-event JSON, byte-comparable across replays
+    ledger: str = ""  # launch-ledger dump JSON, byte-comparable too
 
 
 class InvariantChecker:
@@ -314,6 +315,11 @@ def _run_scenario(plan: ScenarioPlan) -> ScenarioResult:
     # numbering + empty launch log per run (the env flag is scoped by
     # run_scenario, like the backend swap)
     bls_scheduler.configure()
+    # fresh launch ledger riding the scenario's injected StepClock: its
+    # dump is part of the bit-replay contract alongside the trace
+    from ..obs import ledger as launch_ledger
+
+    led = launch_ledger.configure(capacity=1 << 15)
     spec = ChainSpec.interop()
     preset = MINIMAL
     needs_faults = any(
@@ -638,6 +644,12 @@ def _drive_plan(
             if getattr(n.chain, "speculation", None) is not None
         )
 
+    # the scenario's ledger (configured fresh by _run_scenario): audited
+    # against the scheduler's launch log below and dumped into the result
+    from ..obs import ledger as launch_ledger
+
+    led = launch_ledger.default_ledger()
+
     cont_batch = None
     if plan.cont_batch:
         from ..crypto.bls import scheduler as bls_scheduler
@@ -659,6 +671,43 @@ def _drive_plan(
                     f"launch {i} broke deadline admission order: "
                     f"{rec['keys']}"
                 )
+        # the ledger is the EXPORTED surface for the same admissions: every
+        # logged launch must have a matching "sched" record carrying the
+        # lanes and the speculative_withheld / requeue accounting that
+        # previously lived only in the in-process launch_log
+        sched_recs = [r for r in led.records() if r.kind == "sched"]
+        if len(sched_recs) != len(sched.launch_log):
+            failures.append(
+                f"ledger lost launches: {len(sched_recs)} sched records "
+                f"vs {len(sched.launch_log)} logged launches"
+            )
+        else:
+            for i, (rec, logged) in enumerate(
+                zip(sched_recs, sched.launch_log)
+            ):
+                if tuple(rec.lanes or ()) != tuple(logged["lanes"]):
+                    failures.append(
+                        f"ledger launch {i} lane mix diverged from the "
+                        f"audit log: {rec.lanes} vs {logged['lanes']}"
+                    )
+                if (rec.speculative_withheld or 0) != logged[
+                    "speculative_withheld"
+                ]:
+                    failures.append(
+                        f"ledger launch {i} dropped the "
+                        "speculative_withheld count: "
+                        f"{rec.speculative_withheld} vs "
+                        f"{logged['speculative_withheld']}"
+                    )
+        withheld_total = sum(
+            r.speculative_withheld or 0 for r in sched_recs
+        )
+        if withheld_total != sched.stats["preemptions"]:
+            failures.append(
+                "ledger speculative_withheld total "
+                f"{withheld_total} != scheduler preemptions "
+                f"{sched.stats['preemptions']}"
+            )
         cont_batch = dict(sched.stats)
         padded = cont_batch["pad_sets"] + cont_batch["real_sets"]
         cont_batch["pad_waste_ratio"] = (
@@ -666,7 +715,12 @@ def _drive_plan(
         )
         cont_batch["launches_logged"] = len(sched.launch_log)
 
+    from ..utils.monitoring import ledger_health_fields
+
     trace = tracer.dump_json()
+    ledger_dump = led.dump_json()
+    health = trace_health_fields()
+    health["ledger"] = ledger_health_fields(led)
     report = {
         "name": plan.name,
         "seed": plan.seed,
@@ -694,14 +748,16 @@ def _drive_plan(
             "observed_delay_p95_s": observed_p95,
             "imported_delay_p95_s": imported_p95,
             "counter_deltas": deltas,
-            "health": trace_health_fields(),
+            "health": health,
             "failures": failures,
         },
         "fsck_issues": fsck_issues,
         "trace_events": len(tracer.finished_spans()),
         "trace_sha256": hashlib.sha256(trace.encode()).hexdigest(),
+        "ledger_records": len(led.records()),
+        "ledger_sha256": hashlib.sha256(ledger_dump.encode()).hexdigest(),
     }
-    return ScenarioResult(report=report, trace=trace)
+    return ScenarioResult(report=report, trace=trace, ledger=ledger_dump)
 
 
 class _ServingRig:
@@ -843,13 +899,17 @@ def _partition_by_sim_index(sim, groups) -> None:
 
 def assert_bit_identical_replay(plan: ScenarioPlan):
     """Run the plan twice; the two runs must agree on final heads AND
-    export byte-identical traces (the bit-replay contract)."""
+    export byte-identical traces and launch-ledger dumps (the bit-replay
+    contract)."""
     r1 = run_scenario(plan)
     r2 = run_scenario(plan)
     assert r1.report["final_heads"] == r2.report["final_heads"], (
         "replay diverged: final heads differ"
     )
     assert r1.trace == r2.trace, "replay diverged: trace bytes differ"
+    assert r1.ledger == r2.ledger, (
+        "replay diverged: launch-ledger bytes differ"
+    )
     return r1, r2
 
 

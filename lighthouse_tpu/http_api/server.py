@@ -481,6 +481,32 @@ class BeaconApiServer:
                         "application/json",
                     )
                     return
+                if method == "GET" and path == "/lighthouse/ledger/status":
+                    from ..obs.ledger import default_ledger
+
+                    self._send(200, {"data": default_ledger().status()})
+                    return
+                if method == "GET" and path == "/lighthouse/ledger/dump":
+                    # the launch-ledger ring as sorted JSON (the same
+                    # byte-comparable document the replay contract uses)
+                    from ..obs.ledger import default_ledger
+
+                    self._send(
+                        200,
+                        default_ledger().dump_json(),
+                        "application/json",
+                    )
+                    return
+                if method == "GET" and path == "/lighthouse/ledger/report":
+                    # the occupancy / pad-waste / compile-tax table
+                    from ..obs.ledger import default_ledger
+
+                    self._send(
+                        200,
+                        default_ledger().report_text() + "\n",
+                        "text/plain",
+                    )
+                    return
                 if method == "GET" and path == "/eth/v1/events":
                     if "topics" in params:
                         # live chunked stream from the broadcaster

@@ -1,0 +1,7 @@
+"""Observability subsystems that sit NEXT to the span tracer: record-
+level telemetry with the same determinism contract (injected clock +
+rng -> byte-identical replay exports)."""
+
+from . import ledger  # noqa: F401
+
+__all__ = ["ledger"]

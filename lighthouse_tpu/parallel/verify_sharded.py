@@ -39,6 +39,7 @@ from ..crypto.bls.backends.jax_tpu import (
     verify_grouped_jit,
     verify_jit,
 )
+from ..obs import ledger as launch_ledger
 from ..resilience.primitives import CircuitBreaker, EventLog
 from ..utils import metrics, tracing
 
@@ -423,13 +424,24 @@ class MeshVerifier:
         with self.tracer().span("mesh_dispatch", devices=len(mesh_devs)):
             return self.executor.run(fn, args, mesh_devs)
 
-    def _record_chip_timing(self, mesh_devs, seconds: float) -> None:
+    def _record_chip_timing(
+        self, mesh_devs, seconds: float, n_sets: int | None = None
+    ) -> None:
         """Per-chip shard timing: a sharded batch is one collective, so
         every participating chip is charged the batch wall (tracer
         clock); the per-chip labels make a straggling chip visible as a
-        LARGER last-batch wall once the mesh drops it."""
+        LARGER last-batch wall once the mesh drops it. Also the mesh's
+        launch-ledger seam: the per-chip wall is only known here, at
+        materialisation."""
         for d in mesh_devs:
             metrics.MESH_CHIP_BATCH_SECONDS.set(str(d.id), seconds)
+        launch_ledger.record(
+            "mesh",
+            bucket=n_sets,
+            padded_sets=n_sets,
+            devices=len(mesh_devs),
+            chip_seconds=seconds,
+        )
 
     def _materialize(self, mesh_devs, out, args) -> bool:
         """Block on a dispatched verdict; success/failure lands on the
@@ -444,7 +456,9 @@ class MeshVerifier:
             # dispatch and materialisation; re-shard the same batch
             self._on_mesh_fault(mesh_devs, exc)
             return self._verify_blocking(args)
-        self._record_chip_timing(mesh_devs, tracer.clock.now() - t0)
+        self._record_chip_timing(
+            mesh_devs, tracer.clock.now() - t0, n_sets=self._n_sets(args)
+        )
         self._record_mesh_success(mesh_devs)
         return bool(out)
 
@@ -478,7 +492,9 @@ class MeshVerifier:
                 # exception
                 self._on_mesh_fault(mesh_devs, exc)
                 continue
-            self._record_chip_timing(mesh_devs, tracer.clock.now() - t0)
+            self._record_chip_timing(
+                mesh_devs, tracer.clock.now() - t0, n_sets=n_sets
+            )
             self._record_mesh_success(mesh_devs)
             return bool(out)
         raise MeshEmpty(

@@ -242,12 +242,41 @@ def trace_health_fields(tracer=None) -> dict:
     return fields
 
 
+def ledger_health_fields(ledger=None) -> dict:
+    """Launch-ledger-derived health: merged-launch occupancy, the
+    pad-waste ratio, compile tax, and withheld-speculation counts from
+    the per-launch flight recorder (obs/ledger.py). Like
+    trace_health_fields, this is the ONE code path — the remote
+    monitoring push attaches it and the scenario harness's SLO report
+    carries the same numbers."""
+    from ..obs import ledger as launch_ledger
+
+    led = ledger if ledger is not None else launch_ledger.default_ledger()
+    stats = led.stats()
+    fields: dict = {
+        "launch_records": stats["records"],
+        "launch_dropped": stats["dropped"],
+        "cold_dispatches": stats["compile_tax_s"]["cold_dispatches"],
+        "warm_compile_s_total": stats["compile_tax_s"]["total_s"],
+        "speculative_withheld_total": stats["speculative_withheld_total"],
+    }
+    kind = stats.get("pad_waste_kind")
+    occ = stats["occupancy"].get(kind) if kind else None
+    if occ is not None:
+        fields["launch_occupancy"] = occ["ratio"]
+        fields["pad_waste_ratio"] = round(1.0 - occ["ratio"], 4)
+    return fields
+
+
 def beacon_node_source(chain, serving=None) -> dict:
     """Chain-level fields for the beacon_node record (lib.rs:218-243),
-    plus the trace-derived health block (PR-5 follow-up) and — when a
-    serving tier is wired — its cache/SSE/admission counters."""
+    plus the trace-derived health block (PR-5 follow-up), the
+    launch-ledger health block, and — when a serving tier is wired —
+    its cache/SSE/admission counters."""
     head_root, head_state = chain.head()
     fin_epoch, _ = chain.finalized_checkpoint
+    health = trace_health_fields()
+    health["ledger"] = ledger_health_fields()
     fields = {
         "slot": int(chain.current_slot),
         "head_slot": int(head_state.slot),
@@ -255,7 +284,7 @@ def beacon_node_source(chain, serving=None) -> dict:
         "finalized_epoch": int(fin_epoch),
         "validator_count": len(head_state.validators),
         "is_synced": int(chain.current_slot) <= int(head_state.slot) + 1,
-        "health": trace_health_fields(),
+        "health": health,
     }
     if serving is not None:
         fields["serving"] = serving.stats()

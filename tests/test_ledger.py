@@ -1,0 +1,443 @@
+"""Launch-ledger coverage (obs/ledger.py): ring bounds + drop counting,
+rolling-window stats under an injected clock, byte-identical replay
+dumps, one record per instrumented seam (pipeline / scheduler / backend
+dispatch / warm pass / mesh) on fake backends, the HTTP export routes,
+and the `cli ledger` subcommand."""
+
+import json
+import os
+import random
+from types import SimpleNamespace
+
+import pytest
+
+from lighthouse_tpu.crypto.bls import SecretKey, SignatureSet, set_backend
+from lighthouse_tpu.obs import ledger as launch_ledger
+from lighthouse_tpu.obs.ledger import (
+    Ledger,
+    format_report,
+    stats_from_records,
+)
+from lighthouse_tpu.resilience.primitives import VirtualClock
+from lighthouse_tpu.utils import tracing
+
+
+@pytest.fixture(autouse=True)
+def fake_crypto():
+    set_backend("fake")
+    yield
+    set_backend("jax_tpu")
+
+
+@pytest.fixture(autouse=True)
+def fresh_seats():
+    """Every test gets a deterministic tracer and its own ledger; the
+    process seats are restored by re-configuring, same as scenario runs."""
+    tracing.configure(
+        rng=random.Random(0), clock=tracing.StepClock(step=1e-6)
+    )
+    launch_ledger.configure(capacity=256)
+    yield
+    tracing.configure()
+    launch_ledger.configure()
+
+
+def _signature_set(i=0):
+    sk = SecretKey(i + 1)
+    msg = bytes([i]) * 32
+    return SignatureSet.single_pubkey(sk.sign(msg), sk.public_key(), msg)
+
+
+class TestRing:
+    def test_ring_bounds_and_drop_counting(self):
+        led = Ledger(clock=VirtualClock(), capacity=4)
+        for _ in range(7):
+            led.record("pipeline", real_sets=1, padded_sets=1)
+        st = led.status()
+        assert st["recorded"] == 4
+        assert st["dropped"] == 3
+        # the ring sheds the OLDEST: surviving seqs are the last four
+        assert [r.seq for r in led.records()] == [3, 4, 5, 6]
+        assert led.dump()["dropped"] == 3
+
+    def test_unknown_kind_and_unknown_field_rejected(self):
+        led = Ledger(clock=VirtualClock())
+        with pytest.raises(ValueError):
+            led.record("gossip")
+        with pytest.raises(TypeError):
+            led.record("pipeline", not_a_field=1)
+
+    def test_reset_clears_ring_but_seq_keeps_counting(self):
+        led = Ledger(clock=VirtualClock(), capacity=8)
+        led.record("pipeline", real_sets=1)
+        led.reset()
+        rec = led.record("pipeline", real_sets=1)
+        assert led.status()["recorded"] == 1
+        assert rec.seq == 1  # no replayed sequence numbers after reset
+
+    def test_disabled_ledger_records_nothing(self):
+        led = Ledger(clock=VirtualClock(), enabled=False)
+        assert led.record("pipeline", real_sets=1) is None
+        assert led.status()["recorded"] == 0
+
+    def test_env_kill_switch_short_circuits_module_seat(self):
+        prior = os.environ.get("LIGHTHOUSE_TPU_LEDGER")
+        os.environ["LIGHTHOUSE_TPU_LEDGER"] = "0"
+        try:
+            launch_ledger.record("pipeline", real_sets=1)
+            assert launch_ledger.default_ledger().status()["recorded"] == 0
+        finally:
+            if prior is None:
+                os.environ.pop("LIGHTHOUSE_TPU_LEDGER", None)
+            else:
+                os.environ["LIGHTHOUSE_TPU_LEDGER"] = prior
+
+    def test_capacity_env_sizes_default_ring(self):
+        prior = os.environ.get("LIGHTHOUSE_TPU_LEDGER_CAPACITY")
+        os.environ["LIGHTHOUSE_TPU_LEDGER_CAPACITY"] = "17"
+        try:
+            assert Ledger(clock=VirtualClock()).capacity == 17
+        finally:
+            if prior is None:
+                os.environ.pop("LIGHTHOUSE_TPU_LEDGER_CAPACITY", None)
+            else:
+                os.environ["LIGHTHOUSE_TPU_LEDGER_CAPACITY"] = prior
+
+
+class TestStats:
+    def test_rolling_window_under_virtual_clock(self):
+        clock = VirtualClock()
+        led = Ledger(clock=clock, capacity=64)
+        for _ in range(5):
+            led.record("sched", bucket=4, real_sets=2, padded_sets=4)
+            clock.advance(1.0)
+        # window of 2.5s from the LAST record (ts=4.0): ts 2, 3, 4 stay
+        st = led.stats(window_s=2.5)
+        assert st["records"] == 3
+        assert led.stats()["records"] == 5
+
+    def test_occupancy_grouped_by_kind_never_summed_across(self):
+        # one merged launch crossing sched AND pipeline must not double
+        led = Ledger(clock=VirtualClock(), capacity=64)
+        led.record("sched", bucket=4, real_sets=3, padded_sets=4)
+        led.record("pipeline", real_sets=3, padded_sets=4)
+        occ = led.stats()["occupancy"]
+        assert occ["sched"] == {
+            "launches": 1, "real": 3, "padded": 4, "ratio": 0.75
+        }
+        assert occ["pipeline"]["launches"] == 1
+
+    def test_pad_waste_prefers_scheduler_records(self):
+        led = Ledger(clock=VirtualClock(), capacity=64)
+        led.record("sched", bucket=16, real_sets=10, padded_sets=16)
+        led.record("dispatch", bucket=16, real_sets=10, padded_sets=16)
+        st = led.stats()
+        assert st["pad_waste_kind"] == "sched"
+        assert st["pad_waste_per_bucket"]["16"]["waste_ratio"] == 0.375
+
+    def test_compile_tax_and_cold_dispatches(self):
+        led = Ledger(clock=VirtualClock(), capacity=64)
+        led.record("warm", bucket="4x4x4x0", compile_seconds=1.5)
+        led.record("warm", bucket="4x4x4x0", compile_seconds=0.5)
+        led.record("warm", bucket="16x4x16x0", compile_seconds=2.0)
+        led.record("dispatch", bucket=4, real_sets=1, cache_hit=False)
+        led.record("dispatch", bucket=4, real_sets=1, cache_hit=True)
+        tax = led.stats()["compile_tax_s"]
+        assert tax["per_shape_s"] == {"4x4x4x0": 2.0, "16x4x16x0": 2.0}
+        assert tax["total_s"] == 4.0
+        assert tax["cold_dispatches"] == 1
+
+    def test_lane_share_and_withheld_totals(self):
+        led = Ledger(clock=VirtualClock(), capacity=64)
+        led.record(
+            "sched", bucket=4, real_sets=3, padded_sets=4,
+            lane_sets={"block": 1, "aggregate": 2},
+            speculative_withheld=2, slot=1,
+        )
+        led.record(
+            "sched", bucket=4, real_sets=1, padded_sets=4,
+            lane_sets={"block": 1}, speculative_withheld=0, slot=1,
+        )
+        st = led.stats()
+        assert st["lane_share"] == {"aggregate": 0.5, "block": 0.5}
+        assert st["speculative_withheld_total"] == 2
+        assert st["launches_per_slot"]["mean"] == 2.0
+
+    def test_stats_accept_dump_dicts_same_as_records(self):
+        # tools/ledger_report.py feeds dump dicts through the SAME math
+        led = Ledger(clock=VirtualClock(), capacity=64)
+        led.record("sched", bucket=4, real_sets=2, padded_sets=4)
+        from_recs = stats_from_records(led.records())
+        from_dump = stats_from_records(led.dump()["records"])
+        assert from_recs == from_dump
+
+    def test_format_report_renders_every_section(self):
+        led = Ledger(clock=VirtualClock(), capacity=64)
+        led.record(
+            "sched", bucket=4, real_sets=2, padded_sets=4,
+            lane_sets={"block": 2}, speculative_withheld=1, slot=0,
+        )
+        led.record("warm", bucket="4x4x4x0", compile_seconds=1.0)
+        text = format_report(
+            led.stats(), lanes={"block": {"p50_ms": 1.0, "p95_ms": 2.0}}
+        )
+        for needle in (
+            "launch ledger:", "pad waste per bucket", "launches/slot",
+            "compile tax", "lane share", "speculation withheld",
+            "per-lane time-to-verdict",
+        ):
+            assert needle in text
+
+
+class TestReplayAndSeams:
+    def _run_workload(self):
+        """A seeded scheduler workload on the fake backend: the ledger
+        bytes of two runs must match exactly (the bit-replay contract,
+        kept test-sized next to the scenario-level assertion)."""
+        from lighthouse_tpu.crypto.bls import api as bls_api
+        from lighthouse_tpu.crypto.bls import pipeline as bls_pipeline
+        from lighthouse_tpu.crypto.bls import scheduler as bls_scheduler
+
+        tracing.configure(
+            rng=random.Random(7), clock=tracing.StepClock(step=1e-6)
+        )
+        led = launch_ledger.configure(capacity=512)
+        bls_pipeline.configure()
+        sched = bls_scheduler.configure()
+        rng = random.Random(3)
+        sets = [_signature_set(i) for i in range(8)]
+        futs = []
+        for i in range(12):
+            lane = rng.choice(("block", "aggregate", "speculative"))
+            futs.append(
+                bls_api.verify_signature_sets_async(
+                    [sets[rng.randrange(len(sets))]], lane=lane, slot=i % 3
+                )
+            )
+        for f in futs:
+            f.result()
+        sched.drain()
+        bls_pipeline.default_pipeline().drain()
+        return led.dump_json()
+
+    def test_two_replays_dump_identical_bytes(self):
+        prior = os.environ.get("LIGHTHOUSE_TPU_CONT_BATCH")
+        os.environ["LIGHTHOUSE_TPU_CONT_BATCH"] = "1"
+        try:
+            d1 = self._run_workload()
+            d2 = self._run_workload()
+        finally:
+            if prior is None:
+                os.environ.pop("LIGHTHOUSE_TPU_CONT_BATCH", None)
+            else:
+                os.environ["LIGHTHOUSE_TPU_CONT_BATCH"] = prior
+        assert d1 == d2
+        doc = json.loads(d1)
+        kinds = {r["kind"] for r in doc["records"]}
+        assert kinds == {"sched", "pipeline"}
+        # the scheduler's admission audit is ON the exported record
+        sched_recs = [r for r in doc["records"] if r["kind"] == "sched"]
+        assert all(r["lanes"] for r in sched_recs)
+        assert all(r["real_queued_before"] is not None for r in sched_recs)
+
+    def test_pipeline_seam_records_one_per_batch(self):
+        from lighthouse_tpu.crypto.bls import pipeline as bls_pipeline
+
+        led = launch_ledger.configure(capacity=64)
+        pipe = bls_pipeline.configure()
+        for i in range(3):
+            pipe.submit([_signature_set(i)]).result()
+        pipe.drain()
+        recs = [r for r in led.records() if r.kind == "pipeline"]
+        assert len(recs) == 3
+        assert all(r.real_sets == 1 for r in recs)
+
+    def test_sched_seam_carries_preemption_facts(self):
+        """The satellite fix: speculative_withheld / real_queued_before
+        leave the in-process launch_log and ride the exported record."""
+        from lighthouse_tpu.crypto.bls import pipeline as bls_pipeline
+        from lighthouse_tpu.crypto.bls import scheduler as bls_scheduler
+
+        led = launch_ledger.configure(capacity=64)
+        pipe = bls_pipeline.configure()
+        sched = bls_scheduler.configure(pipeline=pipe)
+        sched.submit([_signature_set(0)], lane="speculative")
+        fut = sched.submit([_signature_set(1)], lane="block")
+        fut.result()
+        sched.drain()
+        recs = [r for r in led.records() if r.kind == "sched"]
+        assert recs, "no sched record for a merged launch"
+        first = recs[0]
+        assert "block" in first.lanes
+        assert first.speculative_withheld == 1
+        assert first.real_queued_before == 1
+        total_withheld = sum(r.speculative_withheld or 0 for r in recs)
+        assert total_withheld == sched.stats["preemptions"]
+
+    def test_dispatch_seam_records_bucket_pairs_and_cache_verdict(
+        self, tmp_path, monkeypatch
+    ):
+        """Routing-level (test_multichip idiom): the mesh verifier is
+        faked so the dispatcher's record seam runs without compiling a
+        pairing program."""
+        jax = pytest.importorskip("jax")
+        if len(jax.devices()) < 2:
+            pytest.skip("needs the conftest multi-device CPU mesh")
+        from lighthouse_tpu.crypto.bls.backends import jax_tpu
+        from lighthouse_tpu.utils import compile_cache as CC
+
+        led = launch_ledger.configure(capacity=64)
+        monkeypatch.setenv("LIGHTHOUSE_TPU_SHARD_MIN_SETS", "4")
+        monkeypatch.setattr(
+            jax_tpu, "_MESH", SimpleNamespace(verify=lambda args: True)
+        )
+        saved_dir, saved_seen = CC._ARMED_DIR, set(jax_tpu._seen_shape_buckets)
+        CC._ARMED_DIR = str(tmp_path)
+        jax_tpu._seen_shape_buckets.clear()
+        try:
+            sets = [_signature_set(i) for i in range(3)]
+            assert jax_tpu.dispatch_verify_signature_sets(sets) is True
+        finally:
+            CC._ARMED_DIR = saved_dir
+            jax_tpu._seen_shape_buckets.clear()
+            jax_tpu._seen_shape_buckets.update(saved_seen)
+        recs = [r for r in led.records() if r.kind == "dispatch"]
+        assert len(recs) == 1
+        (rec,) = recs
+        assert rec.real_sets == 3
+        assert rec.bucket == 4 and rec.padded_sets == 4
+        assert rec.miller_pairs == 5  # per-set: n_b + 1
+        assert rec.cache_hit is False  # fresh registry: a cold shape
+
+    def test_warm_seam_records_one_per_bucket(self, tmp_path):
+        from lighthouse_tpu.crypto.bls.backends import jax_tpu
+        from lighthouse_tpu.utils import compile_cache as CC
+
+        led = launch_ledger.configure(capacity=64)
+        saved_dir, saved_seen = CC._ARMED_DIR, set(jax_tpu._seen_shape_buckets)
+        CC._ARMED_DIR = str(tmp_path)
+        jax_tpu._seen_shape_buckets.clear()
+        try:
+            report = jax_tpu.warm_compile(
+                buckets=[(4, 4, 4)], runner=lambda kind, args: None
+            )
+        finally:
+            CC._ARMED_DIR = saved_dir
+            jax_tpu._seen_shape_buckets.clear()
+            jax_tpu._seen_shape_buckets.update(saved_seen)
+        recs = [r for r in led.records() if r.kind == "warm"]
+        assert len(recs) == len(report) == 1
+        assert recs[0].bucket == "4x4x4x0"
+        assert recs[0].real_sets == 0  # warm batches are all padding
+        assert recs[0].compile_seconds is not None
+
+    def test_mesh_seam_records_devices_and_chip_seconds(self):
+        from lighthouse_tpu.parallel import MeshVerifier
+
+        led = launch_ledger.configure(capacity=64)
+
+        class _Exec:
+            def run(self, fn, args, devices):
+                return True
+
+        class _Prober:
+            def probe(self, device):
+                return True
+
+        mv = MeshVerifier(
+            devices=[SimpleNamespace(id=i) for i in range(4)],
+            executor=_Exec(),
+            prober=_Prober(),
+            program_factory=lambda devs: "prog",
+        )
+        args = (None, None, None, None, SimpleNamespace(shape=(64,)))
+        assert bool(mv.verify(args)) is True
+        recs = [r for r in led.records() if r.kind == "mesh"]
+        assert len(recs) == 1
+        assert recs[0].devices == 4
+        assert recs[0].chip_seconds is not None
+        assert recs[0].padded_sets == 64
+
+    def test_chrome_counter_events_sorted_and_typed(self):
+        led = launch_ledger.configure(capacity=64)
+        led.record("sched", bucket=4, real_sets=3, padded_sets=4)
+        led.record("pipeline", real_sets=3, padded_sets=4)
+        events = led.chrome_counter_events()
+        assert [e["ph"] for e in events] == ["C", "C"]
+        assert events[0]["name"] == "ledger/sched"
+        assert events[0]["args"] == {"real": 3, "pad": 1}
+        assert events == sorted(events, key=lambda e: e["ts"])
+
+
+class TestExports:
+    def test_http_routes(self):
+        from lighthouse_tpu.harness import BeaconChainHarness
+        from lighthouse_tpu.http_api import BeaconApi, BeaconApiServer
+        from lighthouse_tpu.types import ChainSpec, MINIMAL
+        from lighthouse_tpu.validator_client import InProcessBeaconNode
+
+        h = BeaconChainHarness(16, MINIMAL, ChainSpec.interop())
+        server = BeaconApiServer(BeaconApi(InProcessBeaconNode(h.chain)))
+        server.start()
+        # fresh ledger AFTER harness setup: chain building must not
+        # contribute records to the route assertions
+        led = launch_ledger.configure(capacity=64)
+        led.record("sched", bucket=4, real_sets=2, padded_sets=4)
+        try:
+            import urllib.request
+
+            base = f"http://127.0.0.1:{server.port}"
+            with urllib.request.urlopen(f"{base}/lighthouse/ledger/status") as r:
+                status = json.loads(r.read())["data"]
+            assert status["recorded"] == 1
+            assert status["kinds"] == {"sched": 1}
+            with urllib.request.urlopen(f"{base}/lighthouse/ledger/dump") as r:
+                dump = json.loads(r.read())
+            assert dump["records"][0]["kind"] == "sched"
+            with urllib.request.urlopen(
+                f"{base}/lighthouse/ledger/report"
+            ) as r:
+                text = r.read().decode()
+            assert "launch ledger: 1 records" in text
+        finally:
+            server.stop()
+
+    def test_cli_ledger_demo_writes_valid_deterministic_dump(
+        self, tmp_path, capsys
+    ):
+        from lighthouse_tpu.cli import main
+
+        out1, out2 = str(tmp_path / "l1.json"), str(tmp_path / "l2.json")
+        argv = ["ledger", "--slots", "2", "--validators", "8", "--report"]
+        assert main(argv + ["--out", out1]) == 0
+        assert main(argv + ["--out", out2]) == 0
+        captured = capsys.readouterr().out
+        assert "launch ledger:" in captured
+        with open(out1) as f:
+            doc = json.load(f)
+        assert doc["records"], "demo sim produced no launch records"
+        with open(out1, "rb") as a, open(out2, "rb") as b:
+            assert a.read() == b.read()
+
+    def test_ledger_report_tool_shares_the_formatter(self, tmp_path, capsys):
+        from tools.ledger_report import main as report_main
+
+        led = Ledger(clock=VirtualClock(), capacity=8)
+        led.record("sched", bucket=4, real_sets=2, padded_sets=4)
+        dump_path = tmp_path / "dump.json"
+        dump_path.write_text(led.dump_json())
+        assert report_main([str(dump_path)]) == 0
+        out_dump = capsys.readouterr().out
+        assert out_dump == format_report(led.stats()) + "\n"
+
+        bench_path = tmp_path / "bench-latency.json"
+        bench_path.write_text(
+            json.dumps(
+                {
+                    "ledger": led.stats(),
+                    "lanes": {"block": {"p50_ms": 1.2, "p95_ms": 3.4}},
+                }
+            )
+        )
+        assert report_main([str(bench_path)]) == 0
+        assert "per-lane time-to-verdict" in capsys.readouterr().out

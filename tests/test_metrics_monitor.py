@@ -878,3 +878,36 @@ class TestNativeRecoveryMetrics:
             "store_native_truncated_bytes_total",
         ):
             assert f"# TYPE {family} counter" in text
+
+
+class TestLedgerHealthFields:
+    """Ledger-derived monitoring fields (utils/monitoring.py): derived
+    through the SAME stats path the report surfaces use, against an
+    injected ledger — no process-seat coupling."""
+
+    def test_fields_derive_from_injected_ledger(self):
+        from lighthouse_tpu.obs.ledger import Ledger
+        from lighthouse_tpu.resilience.primitives import VirtualClock
+        from lighthouse_tpu.utils.monitoring import ledger_health_fields
+
+        led = Ledger(clock=VirtualClock(), capacity=8)
+        led.record(
+            "sched", bucket=4, real_sets=1, padded_sets=4,
+            speculative_withheld=3,
+        )
+        led.record("dispatch", bucket=4, real_sets=1, cache_hit=False)
+        fields = ledger_health_fields(led)
+        assert fields["launch_records"] == 2
+        assert fields["launch_occupancy"] == 0.25
+        assert fields["pad_waste_ratio"] == 0.75
+        assert fields["cold_dispatches"] == 1
+        assert fields["speculative_withheld_total"] == 3
+
+    def test_empty_ledger_reports_zero_counts_without_ratios(self):
+        from lighthouse_tpu.obs.ledger import Ledger
+        from lighthouse_tpu.resilience.primitives import VirtualClock
+        from lighthouse_tpu.utils.monitoring import ledger_health_fields
+
+        fields = ledger_health_fields(Ledger(clock=VirtualClock()))
+        assert fields["launch_records"] == 0
+        assert "launch_occupancy" not in fields  # no launches, no ratio

@@ -171,3 +171,56 @@ def test_trace_health_fields_attach_to_push():
         assert health["queue_wait_p95_seconds"] > 0
     finally:
         rig.stop()
+
+def test_ledger_health_fields_attach_to_push():
+    """Launch-ledger health (occupancy, pad waste, compile tax, withheld
+    speculation) rides the beacon_node record's health block — the same
+    helper the scenario SLO report embeds (one code path)."""
+    from lighthouse_tpu.chain.beacon_chain import BeaconChain
+    from lighthouse_tpu.obs import ledger as launch_ledger
+    from lighthouse_tpu.store.hot_cold import HotColdDB
+    from lighthouse_tpu.store.kv import MemoryStore
+    from lighthouse_tpu.types import ChainSpec, MINIMAL, interop_genesis_state
+    from lighthouse_tpu.utils.monitoring import ledger_health_fields
+
+    led = launch_ledger.configure(capacity=64)
+    try:
+        led.record(
+            "sched", bucket=4, real_sets=3, padded_sets=4,
+            speculative_withheld=2,
+        )
+        led.record("warm", bucket="4x4x4x0", compile_seconds=1.5)
+
+        fields = ledger_health_fields()
+        assert fields["launch_records"] == 2
+        assert fields["launch_dropped"] == 0
+        assert fields["launch_occupancy"] == 0.75
+        assert fields["pad_waste_ratio"] == 0.25
+        assert fields["warm_compile_s_total"] == 1.5
+        assert fields["speculative_withheld_total"] == 2
+
+        spec = ChainSpec.interop()
+        chain = BeaconChain(
+            HotColdDB(MemoryStore(), MINIMAL, spec),
+            interop_genesis_state(16, MINIMAL, spec),
+            MINIMAL,
+            spec,
+        )
+        rig = MonitoringRig().start()
+        try:
+            svc = MonitoringService(
+                rig.url,
+                data_sources={
+                    "beacon_node": lambda: beacon_node_source(chain)
+                },
+            )
+            svc.send_once()
+            (body,) = rig.received
+            proc = next(r for r in body if r["sub_type"] == "process")
+            ledger_block = proc["data"]["health"]["ledger"]
+            assert ledger_block["speculative_withheld_total"] == 2
+            assert ledger_block["launch_occupancy"] == 0.75
+        finally:
+            rig.stop()
+    finally:
+        launch_ledger.configure()

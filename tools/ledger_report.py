@@ -1,0 +1,57 @@
+"""Offline launch-ledger report: the occupancy / pad-waste / compile-tax
+table from a ledger dump or a ``bench.py --latency`` artifact, rendered
+by the SAME formatter as ``cli ledger --report`` and the
+``/lighthouse/ledger/report`` route (obs/ledger.format_report -- one
+code path, every surface).
+
+Inputs auto-detect::
+
+    python -m tools.ledger_report ledger.json        # a dump
+    python -m tools.ledger_report bench-latency.json # a bench artifact
+
+A dump (``{"records": [...]}``) is reduced through
+``stats_from_records``; a bench artifact carries a pre-reduced
+``ledger`` block plus the per-lane p50/p95 time-to-verdict ``lanes``
+block, which the report appends.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+
+
+def render(doc: dict) -> str:
+    from lighthouse_tpu.obs import ledger as launch_ledger
+
+    if "records" in doc:
+        stats = launch_ledger.stats_from_records(
+            doc["records"], dropped=doc.get("dropped", 0)
+        )
+        return launch_ledger.format_report(stats)
+    if "ledger" in doc:
+        return launch_ledger.format_report(
+            doc["ledger"], lanes=doc.get("lanes")
+        )
+    raise SystemExit(
+        "unrecognized input: expected a ledger dump ('records' key) or "
+        "a bench.py --latency artifact ('ledger' key)"
+    )
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        description="print the occupancy/pad-waste/compile-tax table of "
+        "a launch-ledger dump or a bench-latency artifact"
+    )
+    ap.add_argument("path", help="ledger dump JSON or bench-latency.json")
+    args = ap.parse_args(argv)
+    with open(args.path) as f:
+        doc = json.load(f)
+    print(render(doc))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

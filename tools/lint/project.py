@@ -79,6 +79,10 @@ LOCK_ORDER: tuple[str, ...] = (
     "ContinuousBatchScheduler._lock",
     # per-launch settle-once guard (merge fallback runs exactly once)
     "_Launch.lock",
+    # launch-ledger ring append: a LEAF — seams record while holding
+    # scheduler/launch locks, and nothing (no clock read, no tracer
+    # call, no metric) is acquired under it
+    "Ledger._lock",
 )
 
 #: Mesh axis names every `PartitionSpec`/`psum`/`all_gather` must use
